@@ -1,0 +1,19 @@
+"""khipu_tpu — a TPU-native Ethereum execution/storage framework.
+
+A ground-up rebuild of the capabilities of the reference client
+(mahak/khipu, Scala/Akka): optimistic parallel transaction execution with
+application-level race detection, a content-addressed trie-node storage
+engine, and full-chain regular/fast sync — redesigned TPU-first:
+
+* All Keccak-256 hashing of trie nodes runs as batched lane-parallel
+  work on TPU (jax/XLA with a Pallas kernel on the hot path).
+* Merkle-Patricia-Trie commits are level-synchronous bulk operations
+  (one device batch per trie level) instead of node-at-a-time recursion.
+* Multi-chip scale-out uses `jax.sharding.Mesh` + `shard_map` with XLA
+  collectives over ICI, replacing the reference's Akka-cluster sharding.
+* The EVM, ledger merge algebra, networking and storage SPI live host-side,
+  mirroring the reference's layer map (SURVEY.md §1) with the same
+  behavioral contracts (bit-exact state roots).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,32 @@
+"""Primitives layer (L0): bytes, nibbles, RLP, hashing, big-int helpers.
+
+Mirrors the role of the reference's ``khipu-base`` module
+(khipu-base/src/main/scala/khipu/): DataWord/Hash/RLP/MPT primitives —
+except arbitrary-precision arithmetic uses Python ints (the EVM word is a
+plain ``int`` reduced mod 2**256, see khipu_tpu.evm) and the hashing hot
+path is delegated to batched device kernels in khipu_tpu.ops.
+"""
+
+from khipu_tpu.base.bytes_util import (  # noqa: F401
+    big_endian_to_int,
+    bytes_to_hex,
+    hex_to_bytes,
+    int_to_big_endian,
+    int_to_fixed_bytes,
+    xor_bytes,
+)
+from khipu_tpu.base.crypto.keccak import keccak256, keccak512  # noqa: F401
+from khipu_tpu.base.rlp import (  # noqa: F401
+    RLPList,
+    rlp_decode,
+    rlp_encode,
+)
+
+# keccak256(b"") — ubiquitous sentinel (empty account code hash).
+EMPTY_KECCAK = bytes.fromhex(
+    "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+)
+# keccak256(rlp(b"")) — root hash of an empty Merkle Patricia Trie.
+EMPTY_TRIE_HASH = bytes.fromhex(
+    "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+)

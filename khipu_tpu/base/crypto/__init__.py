@@ -1,0 +1,11 @@
+"""Host-side cryptography.
+
+Covers the roles of the reference's khipu-base crypto package
+(khipu-base/src/main/scala/khipu/crypto/: kec256/kec512, sha256,
+ripemd160, secp256k1 ECDSA) and khipu-eth's zksnark/BN128 + Blake2bf —
+all pure Python (no external crypto deps in the image). The *batched*
+Keccak hot path lives on-device in khipu_tpu.ops; these are the scalar
+reference implementations and the test oracle.
+"""
+
+from khipu_tpu.base.crypto.keccak import keccak256, keccak512  # noqa: F401
