@@ -65,18 +65,20 @@ def keccak_f1600(state: list) -> None:
         state[0] ^= rc
 
 
-def keccak_pad(data: bytes, rate: int) -> bytes:
-    """Multi-rate pad10*1 with Keccak domain bit 0x01."""
+def keccak_pad(data: bytes, rate: int, domain: int = 0x01) -> bytes:
+    """Multi-rate pad10*1. domain=0x01 is original Keccak (Ethereum);
+    0x06 is NIST SHA-3 — exposed so tests can cross-validate the
+    permutation/absorb loop against an independent SHA3 implementation."""
     pad_len = rate - (len(data) % rate)
     padding = bytearray(pad_len)
-    padding[0] = 0x01
+    padding[0] = domain
     padding[-1] |= 0x80
     return data + bytes(padding)
 
 
-def _keccak(data: bytes, rate: int, out_len: int) -> bytes:
+def _keccak(data: bytes, rate: int, out_len: int, domain: int = 0x01) -> bytes:
     state = [0] * 25
-    padded = keccak_pad(data, rate)
+    padded = keccak_pad(data, rate, domain)
     lanes = rate // 8
     for off in range(0, len(padded), rate):
         block = padded[off : off + rate]
@@ -102,3 +104,9 @@ def keccak256(data: bytes) -> bytes:
 def keccak512(data: bytes) -> bytes:
     """keccak-512 (rate 72); used by Ethash dataset generation."""
     return _keccak(bytes(data), 72, 64)
+
+
+def sha3_256(data: bytes) -> bytes:
+    """NIST SHA3-256 (domain 0x06) — same sponge, used only to
+    cross-validate the permutation against hashlib."""
+    return _keccak(bytes(data), 136, 32, domain=0x06)

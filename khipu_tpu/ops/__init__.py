@@ -1,0 +1,14 @@
+"""Device compute kernels (the TPU hot path).
+
+This package owns the work the reference does in its JVM hot loops
+(khipu-base/.../crypto/hash/KeccakCore.scala sponge; the per-node
+``kec256(rlp(node))`` in trie/Node.scala:111-112) — redesigned as
+batched, lane-parallel array programs:
+
+* keccak: Keccak-f[1600] over a whole batch of messages at once,
+  64-bit lanes emulated as uint32 (hi, lo) pairs because the TPU VPU
+  has no 64-bit integer ALU. jnp implementation (runs on any backend,
+  XLA-fused) + a Pallas TPU kernel keeping the sponge state in VMEM.
+"""
+
+from khipu_tpu.ops.keccak import keccak256_batch  # noqa: F401

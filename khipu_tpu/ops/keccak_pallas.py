@@ -1,0 +1,192 @@
+"""Pallas TPU kernel: batched Keccak-256 with the sponge state in VMEM.
+
+One grid step hashes a tile of 8*128 = 1024 messages: each of the 50
+u32 state half-lanes is an (8, 128) VPU-shaped tile, so every Keccak op
+is a full-width elementwise VPU instruction and the 24-round permutation
+never touches HBM. This is the TPU replacement for the reference's
+scalar JVM sponge hot loop (khipu-base/.../crypto/hash/KeccakCore.scala
+invoked per trie node at trie/Node.scala:111-112).
+
+Input layout (host-packed by khipu_tpu.ops.keccak_jnp.pad_to_blocks and
+retiled here): uint32[tiles, nblocks*34, 8, 128] — word-major, batch in
+the (sublane, lane) dims. Output: uint32[tiles, 8, 8, 128] digest words.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from khipu_tpu.ops.keccak_jnp import (
+    _RC32,
+    _round,
+    LANES_PER_BLOCK,
+    RATE,
+    pad_batch_count,
+    pad_to_blocks,
+)
+
+TILE = 8 * 128  # messages per grid step
+
+
+def _make_kernel(nblocks: int):
+    def kernel(blocks_ref, out_ref):
+        zero = jnp.zeros((8, 128), jnp.uint32)
+        lo: List = [zero] * 25
+        hi: List = [zero] * 25
+        for b in range(nblocks):
+            base = b * 2 * LANES_PER_BLOCK
+            for i in range(LANES_PER_BLOCK):
+                lo[i] = lo[i] ^ blocks_ref[0, base + 2 * i]
+                hi[i] = hi[i] ^ blocks_ref[0, base + 2 * i + 1]
+            for rc_lo, rc_hi in _RC32:
+                lo, hi = _round(lo, hi, jnp.uint32(rc_lo), jnp.uint32(rc_hi))
+        for k in range(4):
+            out_ref[0, 2 * k] = lo[k]
+            out_ref[0, 2 * k + 1] = hi[k]
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=32)
+def _build(nblocks: int, interpret: bool):
+    nwords = nblocks * 2 * LANES_PER_BLOCK
+
+    @jax.jit
+    def run(blocks):  # uint32[tiles, nwords, 8, 128]
+        tiles = blocks.shape[0]
+        return pl.pallas_call(
+            _make_kernel(nblocks),
+            grid=(tiles,),
+            in_specs=[
+                pl.BlockSpec((1, nwords, 8, 128), lambda i: (i, 0, 0, 0))
+            ],
+            out_specs=pl.BlockSpec((1, 8, 8, 128), lambda i: (i, 0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((tiles, 8, 8, 128), jnp.uint32),
+            interpret=interpret,
+        )(blocks)
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _build_from_bytes(nblocks: int, interpret: bool):
+    """Fused device-side pack + hash for fixed-size padded messages.
+
+    Takes uint8[N, nblocks*RATE] already multi-rate padded (host does the
+    two xor bytes, vectorized); does the u8->u32 bitcast and the
+    word-major retile on device, where they are cheap HBM shuffles, then
+    runs the kernel. Avoids the multi-second host-side numpy transposes.
+    """
+    nwords = nblocks * 2 * LANES_PER_BLOCK
+    run = _build(nblocks, interpret)
+
+    @jax.jit
+    def go(padded_u8):  # uint8[N, nblocks*RATE], N % TILE == 0
+        n = padded_u8.shape[0]
+        tiles = n // TILE
+        w = jax.lax.bitcast_convert_type(
+            padded_u8.reshape(n, nwords, 4), jnp.uint32
+        )  # little-endian on TPU/x86 -> matches '<u4'
+        tiled = w.reshape(tiles, 8, 128, nwords).transpose(0, 3, 1, 2)
+        out = run(tiled)  # (tiles, 8, 8, 128)
+        # back to digest-major: (N, 8) words -> bitcast to bytes
+        d = out.transpose(0, 2, 3, 1).reshape(n, 8)
+        return jax.lax.bitcast_convert_type(d, jnp.uint8).reshape(n, 32)
+
+    return go
+
+
+@functools.lru_cache(maxsize=32)
+def _build_device_fixed(length: int, interpret: bool):
+    """Fully device-resident: pad + pack + hash uint8[N, length] on device.
+
+    No host round-trip: use when the node bytes already live on device
+    (or are generated there, as in the microbench). Returns uint8[N, 32].
+    """
+    nblocks = length // RATE + 1
+    run_bytes = _build_from_bytes(nblocks, interpret)
+
+    @jax.jit
+    def go(data_u8):  # uint8[N, length], N % TILE == 0
+        n = data_u8.shape[0]
+        tail = np.zeros(nblocks * RATE - length, dtype=np.uint8)
+        tail[0] ^= 0x01
+        tail[-1] ^= 0x80
+        pad = jnp.broadcast_to(jnp.asarray(tail), (n, tail.shape[0]))
+        return run_bytes(jnp.concatenate([data_u8, pad], axis=1))
+
+    return go
+
+
+def keccak256_fixed(
+    data: np.ndarray, interpret: bool = False
+) -> np.ndarray:
+    """Hash N equal-length messages: uint8[N, L] -> uint8[N, 32].
+
+    The bulk-commit fast path (all dirty trie nodes of one size class in
+    one device call). Pads on host (vectorized), packs and hashes on
+    device.
+    """
+    n, length = data.shape
+    nblocks = length // RATE + 1
+    padded = np.zeros((n, nblocks * RATE), dtype=np.uint8)
+    padded[:, :length] = data
+    padded[:, length] ^= 0x01
+    padded[:, nblocks * RATE - 1] ^= 0x80
+    pad_rows = pad_batch_count(n, floor=TILE) - n
+    if pad_rows:
+        extra = np.zeros((pad_rows, nblocks * RATE), dtype=np.uint8)
+        extra[:, length] ^= 0x01
+        extra[:, nblocks * RATE - 1] ^= 0x80
+        padded = np.concatenate([padded, extra], axis=0)
+    out = _build_from_bytes(nblocks, interpret)(jnp.asarray(padded))
+    return np.asarray(jax.device_get(out))[:n]
+
+
+def retile(blocks: np.ndarray) -> np.ndarray:
+    """uint32[nblocks, 34, B] (B % 1024 == 0) -> [tiles, nblocks*34, 8, 128]."""
+    nblocks, nwords_per_block, batch = blocks.shape
+    tiles = batch // TILE
+    # -> (B, nblocks*34)
+    flat = blocks.reshape(nblocks * nwords_per_block, batch).T
+    # -> (tiles, 8, 128, nwords) -> (tiles, nwords, 8, 128)
+    return np.ascontiguousarray(
+        flat.reshape(tiles, 8, 128, nblocks * nwords_per_block).transpose(0, 3, 1, 2)
+    )
+
+
+def keccak256_batch_pallas(
+    messages: Sequence[bytes], interpret: bool = False
+) -> List[bytes]:
+    """Hash variable-length messages via the Pallas kernel.
+
+    Buckets by rate-block count, zero-pads each bucket to a whole
+    1024-message tile (padding digests discarded).
+    """
+    if not messages:
+        return []
+    buckets = {}
+    for idx, m in enumerate(messages):
+        buckets.setdefault(len(m) // RATE + 1, []).append(idx)
+    out: List = [None] * len(messages)
+    for nblocks, idxs in sorted(buckets.items()):
+        msgs = [messages[i] for i in idxs]
+        # whole tiles AND power-of-two tile count, to bound jit specializations
+        filler = b"\x00" * ((nblocks - 1) * RATE)
+        msgs += [filler] * (pad_batch_count(len(msgs), floor=TILE) - len(msgs))
+        packed = pad_to_blocks(msgs, nblocks)
+        tiled = retile(packed)
+        words = _build(nblocks, interpret)(jnp.asarray(tiled))
+        arr = np.asarray(jax.device_get(words), dtype="<u4")  # (tiles, 8, 8, 128)
+        # invert retile: digest j lives at [j//1024, :, (j%1024)//128, j%128]
+        for pos, i in enumerate(idxs):
+            t, r = divmod(pos, TILE)
+            s, l = divmod(r, 128)
+            out[i] = arr[t, :, s, l].tobytes()
+    return out

@@ -37,12 +37,19 @@ class TestKeccak:
         # root of the empty MPT = keccak256(rlp(b""))
         assert keccak256(rlp_encode(b"")) == EMPTY_TRIE_HASH
 
-    def test_long_input_multiblock(self):
-        # > 1 rate block (136 bytes) forces multiple permutations
-        data = bytes(range(256)) * 3
-        d1 = keccak256(data)
-        # sanity: deterministic and 32 bytes
-        assert len(d1) == 32 and d1 == keccak256(bytes(data))
+    def test_multiblock_absorb_vs_hashlib_sha3(self):
+        # Independent cross-validation of the permutation + multi-block
+        # absorb loop: our sponge with NIST domain byte 0x06 must equal
+        # hashlib's SHA3-256 (OpenSSL). Combined with the single-block
+        # Keccak known-answer vectors (which pin the 0x01 domain), this
+        # covers the whole multi-block path.
+        import hashlib
+
+        from khipu_tpu.base.crypto.keccak import sha3_256
+
+        for n in (0, 1, 135, 136, 137, 272, 500, 1000, 4096):
+            data = bytes((i * 7 + n) % 256 for i in range(n))
+            assert sha3_256(data) == hashlib.sha3_256(data).digest(), n
 
     def test_keccak512_len(self):
         assert len(keccak512(b"khipu")) == 64

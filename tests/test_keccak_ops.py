@@ -1,0 +1,59 @@
+"""Batched device Keccak vs the scalar oracle (SURVEY.md §4 test plan
+item 2: kernel tests — batched digests vs known-good reference)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.ops.keccak_jnp import keccak256_batch_jnp, pad_to_blocks
+from khipu_tpu.ops.keccak import keccak256_batch
+
+
+class TestJnpBatch:
+    def test_small_sizes_vs_oracle(self):
+        random.seed(7)
+        # one- and two-block classes (keeps CPU compile time sane)
+        msgs = [random.randbytes(n) for n in (0, 1, 31, 55, 56, 135, 136, 200, 271)]
+        got = keccak256_batch_jnp(msgs)
+        for g, m in zip(got, msgs):
+            assert g == keccak256(m), f"len={len(m)}"
+
+    def test_batch_order_preserved_across_buckets(self):
+        random.seed(8)
+        msgs = [random.randbytes(n) for n in (140, 3, 139, 7, 0)]
+        got = keccak256_batch_jnp(msgs)
+        assert [g for g in got] == [keccak256(m) for m in msgs]
+
+    def test_empty_batch(self):
+        assert keccak256_batch_jnp([]) == []
+
+    def test_wrong_class_rejected(self):
+        with pytest.raises(ValueError):
+            pad_to_blocks([b"x" * 200], 1)
+
+    def test_dispatcher_jnp_on_cpu(self):
+        msgs = [b"khipu", b""]
+        assert keccak256_batch(msgs, impl="auto") == [keccak256(m) for m in msgs]
+
+
+class TestPallasInterpret:
+    def test_one_block_class_vs_oracle(self):
+        from khipu_tpu.ops.keccak_pallas import keccak256_batch_pallas
+
+        random.seed(9)
+        msgs = [random.randbytes(n) for n in (0, 1, 64, 135)]
+        got = keccak256_batch_pallas(msgs, interpret=True)
+        for g, m in zip(got, msgs):
+            assert g == keccak256(m), f"len={len(m)}"
+
+    def test_fixed_path_vs_oracle(self):
+        from khipu_tpu.ops.keccak_pallas import keccak256_fixed
+
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 256, size=(6, 100), dtype=np.uint8)
+        out = keccak256_fixed(data, interpret=True)
+        assert out.shape == (6, 32)
+        for i in range(6):
+            assert out[i].tobytes() == keccak256(data[i].tobytes())
