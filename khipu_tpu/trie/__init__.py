@@ -1,0 +1,12 @@
+"""Merkle Patricia Trie — host reference + TPU level-synchronous commit.
+
+Parity target: khipu-base/src/main/scala/khipu/trie/ (MerklePatriciaTrie.scala,
+Node.scala, HexPrefix.scala). The host implementation is the bit-exactness
+oracle; the TPU path (bulk.py) batches all node hashing per trie level onto
+the device Keccak kernel.
+"""
+
+from khipu_tpu.trie.mpt import EMPTY_TRIE_HASH, MerklePatriciaTrie
+from khipu_tpu.trie.bulk import bulk_build
+
+__all__ = ["EMPTY_TRIE_HASH", "MerklePatriciaTrie", "bulk_build"]

@@ -1,0 +1,212 @@
+"""MPT correctness: golden vectors, fuzz vs bulk builder, genesis root.
+
+The mainnet genesis state root / block hash constants below are public
+chain facts (any Ethereum client computes them), giving an external
+bit-exactness oracle per SURVEY.md §4 item (3).
+"""
+
+import gzip
+import os
+import random
+
+import pytest
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.base.rlp import rlp_encode
+from khipu_tpu.trie import EMPTY_TRIE_HASH, MerklePatriciaTrie, bulk_build
+from khipu_tpu.trie.bulk import host_hasher
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+MAINNET_GENESIS_STATE_ROOT = bytes.fromhex(
+    "d7f8974fb5ac78d9ac099b9ad5018bedc2ce0a72dad1827a1709da30580f0544"
+)
+
+
+class DictSource:
+    def __init__(self):
+        self.d = {}
+
+    def get(self, k):
+        return self.d.get(k)
+
+    def put(self, k, v):
+        self.d[k] = v
+
+    def update(self, to_remove, to_upsert):
+        self.d.update(to_upsert)
+
+
+def fresh():
+    return MerklePatriciaTrie(DictSource())
+
+
+def test_empty_trie_hash():
+    assert EMPTY_TRIE_HASH.hex() == (
+        "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+    )
+    assert fresh().root_hash == EMPTY_TRIE_HASH
+
+
+def test_known_vector_dogs():
+    # Canonical MPT example (appears in the yellow-paper literature).
+    pairs = {
+        b"do": b"verb",
+        b"dog": b"puppy",
+        b"doge": b"coin",
+        b"horse": b"stallion",
+    }
+    t = fresh()
+    for k, v in pairs.items():
+        t = t.put(k, v)
+    assert t.root_hash.hex() == (
+        "5991bb8c6514148a29db676a14ac506cd2cd5775ace63c30a4fe457715e9ac84"
+    )
+    for k, v in pairs.items():
+        assert t.get(k) == v
+    assert t.get(b"dogs") is None
+    # insertion order must not matter
+    t2 = fresh()
+    for k in reversed(list(pairs)):
+        t2 = t2.put(k, pairs[k])
+    assert t2.root_hash == t.root_hash
+    # bulk builder agrees
+    root, _ = bulk_build(pairs.items())
+    assert root == t.root_hash
+
+
+def test_single_entry_and_overwrite():
+    t = fresh().put(b"k", b"v1")
+    r1 = t.root_hash
+    t = t.put(b"k", b"v2")
+    assert t.get(b"k") == b"v2"
+    t = t.put(b"k", b"v1")
+    assert t.root_hash == r1
+
+
+def test_remove_returns_to_prior_root():
+    t = fresh()
+    t = t.put(b"alpha", b"1")
+    r1 = t.root_hash
+    t = t.put(b"alphabet", b"2").put(b"beta", b"3")
+    t = t.remove(b"alphabet").remove(b"beta")
+    assert t.root_hash == r1
+    t = t.remove(b"alpha")
+    assert t.root_hash == EMPTY_TRIE_HASH
+
+
+def test_branch_value_slot():
+    # One key a strict prefix of another → branch with terminator value.
+    t = fresh().put(b"ab", b"outer").put(b"abcd", b"inner")
+    assert t.get(b"ab") == b"outer"
+    assert t.get(b"abcd") == b"inner"
+    t2 = t.remove(b"ab")
+    assert t2.get(b"ab") is None
+    assert t2.get(b"abcd") == b"inner"
+    assert t2.root_hash == fresh().put(b"abcd", b"inner").root_hash
+
+
+def test_persist_and_reopen():
+    src = DictSource()
+    t = MerklePatriciaTrie(src)
+    data = {bytes([i, i ^ 0x5A]) * 4: b"value-%d" % i for i in range(64)}
+    for k, v in data.items():
+        t = t.put(k, v)
+    root = t.root_hash
+    t = t.persist()
+    reopened = MerklePatriciaTrie(src, root_hash=root)
+    for k, v in data.items():
+        assert reopened.get(k) == v
+    # mutate the reopened trie across persisted boundary
+    reopened = reopened.put(b"new-key", b"new-value").persist()
+    again = MerklePatriciaTrie(src, root_hash=reopened.root_hash)
+    assert again.get(b"new-key") == b"new-value"
+
+
+@pytest.mark.parametrize("seed", [1, 7, 2026])
+def test_fuzz_incremental_vs_bulk(seed):
+    rng = random.Random(seed)
+    n = 300
+    pairs = {}
+    for _ in range(n):
+        klen = rng.randint(1, 48)
+        pairs[rng.randbytes(klen)] = rng.randbytes(rng.randint(1, 80))
+    t = fresh()
+    keys = list(pairs)
+    rng.shuffle(keys)
+    for k in keys:
+        t = t.put(k, pairs[k])
+    bulk_root, nodes = bulk_build(pairs.items(), hasher=host_hasher)
+    assert t.root_hash == bulk_root
+    # node sets persisted by the incremental path == bulk path
+    _, upserts = t.changes()
+    assert set(upserts) == set(nodes)
+
+    # remove a random half; incremental root must equal bulk of remainder
+    removed = set(rng.sample(keys, n // 2))
+    for k in removed:
+        t = t.remove(k)
+    remaining = {k: v for k, v in pairs.items() if k not in removed}
+    assert t.root_hash == bulk_build(remaining.items())[0]
+    for k in removed:
+        assert t.get(k) is None
+    for k, v in remaining.items():
+        assert t.get(k) == v
+
+
+def test_secure_trie_style_keys():
+    # State-trie usage: key = keccak256(address), value = rlp(account).
+    rng = random.Random(99)
+    pairs = {}
+    for i in range(200):
+        addr = rng.randbytes(20)
+        account = [
+            rlp_int(0),
+            rlp_int(rng.randint(1, 10**20)),
+            EMPTY_TRIE_HASH,
+            keccak256(b""),
+        ]
+        pairs[keccak256(addr)] = rlp_encode(account)
+    t = fresh()
+    for k, v in pairs.items():
+        t = t.put(k, v)
+    assert t.root_hash == bulk_build(pairs.items())[0]
+
+
+def rlp_int(v: int) -> bytes:
+    return v.to_bytes((v.bit_length() + 7) // 8, "big") if v else b""
+
+
+def genesis_alloc():
+    path = os.path.join(FIXTURES, "mainnet_genesis_alloc.txt.gz")
+    with gzip.open(path, "rt") as f:
+        for line in f:
+            addr, bal = line.split()
+            yield bytes.fromhex(addr), int(bal)
+
+
+def genesis_state_pairs():
+    empty_code_hash = keccak256(b"")
+    for addr, bal in genesis_alloc():
+        account = [rlp_int(0), rlp_int(bal), EMPTY_TRIE_HASH, empty_code_hash]
+        yield keccak256(addr), rlp_encode(account)
+
+
+def test_mainnet_genesis_state_root_bulk():
+    """8893-account mainnet genesis alloc → the exact geth state root."""
+    root, nodes = bulk_build(genesis_state_pairs(), hasher=host_hasher)
+    assert root == MAINNET_GENESIS_STATE_ROOT
+    assert len(nodes) > 8893  # every account leaf hashes to >=32B
+
+
+def test_mainnet_genesis_state_root_incremental_subset():
+    """Incremental trie agrees with bulk on a 500-account prefix."""
+    pairs = []
+    for i, kv in enumerate(genesis_state_pairs()):
+        if i >= 500:
+            break
+        pairs.append(kv)
+    t = fresh()
+    for k, v in pairs:
+        t = t.put(k, v)
+    assert t.root_hash == bulk_build(pairs)[0]
