@@ -96,14 +96,57 @@ def _keccak(data: bytes, rate: int, out_len: int, domain: int = 0x01) -> bytes:
     return bytes(out[:out_len])
 
 
+def keccak256_py(data: bytes) -> bytes:
+    """Pure-Python keccak-256 — the bootstrap oracle the native and
+    device paths are tested against."""
+    return _keccak(bytes(data), 136, 32)
+
+
+def keccak512_py(data: bytes) -> bytes:
+    return _keccak(bytes(data), 72, 64)
+
+
+_keccak256_impl = None
+_keccak512_impl = None
+
+
+def _bind():
+    """Prefer the native C++ sponge (khipu_tpu/native/csrc/keccak.cc,
+    ~500x the pure-Python speed); fall back to Python where g++ is
+    unavailable. Bound lazily on first hash — binding may compile the
+    library, which must not happen at import time. Tests pin
+    native == python == device."""
+    global _keccak256_impl, _keccak512_impl
+    try:
+        from khipu_tpu.native import keccak as native
+
+        if native.available():
+            _keccak256_impl = native.keccak256
+            _keccak512_impl = native.keccak512
+            return
+    except Exception:
+        pass
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "native keccak unavailable; using the ~500x slower pure-Python path"
+    )
+    _keccak256_impl = keccak256_py
+    _keccak512_impl = keccak512_py
+
+
 def keccak256(data: bytes) -> bytes:
     """keccak-256 (rate 136); == reference kec256 (crypto/package.scala:37)."""
-    return _keccak(bytes(data), 136, 32)
+    if _keccak256_impl is None:
+        _bind()
+    return _keccak256_impl(bytes(data))
 
 
 def keccak512(data: bytes) -> bytes:
     """keccak-512 (rate 72); used by Ethash dataset generation."""
-    return _keccak(bytes(data), 72, 64)
+    if _keccak512_impl is None:
+        _bind()
+    return _keccak512_impl(bytes(data))
 
 
 def sha3_256(data: bytes) -> bytes:

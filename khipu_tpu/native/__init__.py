@@ -1,0 +1,13 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA/Pallas; this package holds the host-side
+native pieces whose roles the reference fills with JVM/JNI code
+(SURVEY.md §2.10): the Keccak hot loop (KeccakCore.scala) and the
+append-log node store (khipu-kesque). Built on demand with g++; every
+consumer has a pure-Python fallback so the framework still works where
+no toolchain exists.
+"""
+
+from khipu_tpu.native.build import load_library
+
+__all__ = ["load_library"]

@@ -1,0 +1,60 @@
+"""On-demand g++ build of the native shared library, cached by mtime."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(_DIR, "csrc")
+_OUT = os.path.join(_DIR, "_libkhipu_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _sources():
+    return sorted(
+        os.path.join(_CSRC, f)
+        for f in os.listdir(_CSRC)
+        if f.endswith(".cc")
+    )
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_OUT):
+        return True
+    out_mtime = os.path.getmtime(_OUT)
+    return any(os.path.getmtime(s) > out_mtime for s in _sources())
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Compile (if stale) and dlopen the native library.
+
+    Returns None when no working toolchain is available; callers fall
+    back to pure Python.
+    """
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            if _needs_build():
+                cmd = [
+                    "g++", "-O3", "-march=native", "-shared", "-fPIC",
+                    "-std=c++17", "-o", _OUT, *_sources(),
+                ]
+                subprocess.run(
+                    cmd, check=True, capture_output=True, timeout=300
+                )
+            _lib = ctypes.CDLL(_OUT)
+        except Exception:
+            _failed = True
+            _lib = None
+        return _lib

@@ -1,0 +1,70 @@
+"""ctypes bindings for the native Keccak (csrc/keccak.cc)."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence
+
+from khipu_tpu.native.build import load_library
+
+_RATE_256 = 136
+_RATE_512 = 72
+
+_configured = False
+_lib = None
+
+
+def _get_lib():
+    global _configured, _lib
+    if not _configured:
+        _configured = True
+        lib = load_library()
+        if lib is not None:
+            lib.khipu_keccak.argtypes = [
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.khipu_keccak_batch.argtypes = [
+                ctypes.c_int, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+                ctypes.c_char_p, ctypes.c_int,
+            ]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+def _digest(data: bytes, rate: int, out_len: int) -> bytes:
+    lib = _get_lib()
+    out = ctypes.create_string_buffer(out_len)
+    lib.khipu_keccak(rate, bytes(data), len(data), out, out_len)
+    return out.raw
+
+
+def keccak256(data: bytes) -> bytes:
+    return _digest(data, _RATE_256, 32)
+
+
+def keccak512(data: bytes) -> bytes:
+    return _digest(data, _RATE_512, 64)
+
+
+def keccak256_batch(messages: Sequence[bytes]) -> List[bytes]:
+    lib = _get_lib()
+    n = len(messages)
+    if n == 0:
+        return []
+    blob = b"".join(messages)
+    offsets = (ctypes.c_uint64 * (n + 1))()
+    pos = 0
+    for i, m in enumerate(messages):
+        offsets[i] = pos
+        pos += len(m)
+    offsets[n] = pos
+    out = ctypes.create_string_buffer(32 * n)
+    lib.khipu_keccak_batch(_RATE_256, blob, offsets, n, out, 32)
+    raw = out.raw
+    return [raw[i * 32 : (i + 1) * 32] for i in range(n)]

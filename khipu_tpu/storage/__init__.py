@@ -1,0 +1,38 @@
+"""Storage subsystem — SPI traits, engines, façade.
+
+Parity targets: khipu-storage (DataSource SPI, SURVEY §2.2),
+khipu-eth/storage façade (§2.6), khipu-kesque role (§2.3; the native
+C++ append-log engine lives in khipu_tpu/native).
+"""
+
+from khipu_tpu.storage.datasource import (
+    BlockDataSource,
+    DataSource,
+    KeyValueDataSource,
+    MemoryBlockDataSource,
+    MemoryKeyValueDataSource,
+    MemoryNodeDataSource,
+    NodeDataSource,
+)
+from khipu_tpu.storage.cache import Clock, FIFOCache
+from khipu_tpu.storage.unconfirmed import SimpleMapWithUnconfirmed
+from khipu_tpu.storage.node_storage import NodeStorage, ReadOnlyNodeStorage
+from khipu_tpu.storage.app_state import AppStateStorage
+from khipu_tpu.storage.storages import Storages
+
+__all__ = [
+    "AppStateStorage",
+    "BlockDataSource",
+    "Clock",
+    "DataSource",
+    "FIFOCache",
+    "KeyValueDataSource",
+    "MemoryBlockDataSource",
+    "MemoryKeyValueDataSource",
+    "MemoryNodeDataSource",
+    "NodeDataSource",
+    "NodeStorage",
+    "ReadOnlyNodeStorage",
+    "SimpleMapWithUnconfirmed",
+    "Storages",
+]

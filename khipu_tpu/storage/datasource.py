@@ -1,0 +1,156 @@
+"""DataSource SPI + in-memory engines.
+
+Parity: khipu-storage/.../datasource/DataSource.scala:6 (count /
+cacheHitRate / clock / stop over the SimpleMap get/put/update
+contract), NodeDataSource.scala:5 (Hash -> bytes, content-addressed),
+BlockDataSource.scala:3 (Long -> bytes + bestBlockNumber),
+KeyValueDataSource.scala:3; EphemNodeDataSource (the reference's own
+in-memory fake used by GenesisDataLoader and MptListValidator) is the
+model for the Memory* engines here.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+from khipu_tpu.storage.cache import Clock
+
+
+class DataSource:
+    """Common DataSource surface: metrics + lifecycle."""
+
+    def __init__(self) -> None:
+        self.clock = Clock()
+
+    @property
+    def count(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def cache_hit_rate(self) -> float:
+        return 0.0
+
+    @property
+    def cache_read_count(self) -> int:
+        return 0
+
+    def stop(self) -> None:
+        pass
+
+
+class KeyValueDataSource(DataSource):
+    """bytes -> bytes store."""
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.update([], {key: value})
+
+    def remove(self, key: bytes) -> None:
+        self.update([key], {})
+
+    def update(
+        self, to_remove: Iterable[bytes], to_upsert: Mapping[bytes, bytes]
+    ) -> None:
+        raise NotImplementedError
+
+
+class NodeDataSource(KeyValueDataSource):
+    """Content-addressed trie-node store: key == keccak256(value).
+
+    Engines may therefore skip storing keys and recompute them from
+    values (KesqueNodeDataSource.scala:61-63 does exactly this)."""
+
+
+class BlockDataSource(DataSource):
+    """block-number -> bytes append store tracking the best number."""
+
+    def get(self, number: int) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put(self, number: int, value: bytes) -> None:
+        self.update([], {number: value})
+
+    def update(
+        self, to_remove: Iterable[int], to_upsert: Mapping[int, bytes]
+    ) -> None:
+        raise NotImplementedError
+
+    @property
+    def best_block_number(self) -> int:
+        raise NotImplementedError
+
+
+class MemoryKeyValueDataSource(KeyValueDataSource):
+    def __init__(self) -> None:
+        super().__init__()
+        self._map: Dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        t0 = self.clock.start()
+        try:
+            return self._map.get(bytes(key))
+        finally:
+            self.clock.elapse(t0)
+
+    def update(self, to_remove, to_upsert) -> None:
+        with self._lock:
+            for k in to_remove:
+                self._map.pop(bytes(k), None)
+            for k, v in to_upsert.items():
+                self._map[bytes(k)] = bytes(v)
+
+    @property
+    def count(self) -> int:
+        return len(self._map)
+
+    def keys(self) -> List[bytes]:
+        return list(self._map.keys())
+
+
+class MemoryNodeDataSource(MemoryKeyValueDataSource, NodeDataSource):
+    """In-memory content-addressed node store (EphemNodeDataSource)."""
+
+
+class MemoryBlockDataSource(BlockDataSource):
+    def __init__(self) -> None:
+        super().__init__()
+        self._map: Dict[int, bytes] = {}
+        self._best = -1
+        self._lock = threading.Lock()
+
+    def get(self, number: int) -> Optional[bytes]:
+        t0 = self.clock.start()
+        try:
+            return self._map.get(int(number))
+        finally:
+            self.clock.elapse(t0)
+
+    def update(self, to_remove, to_upsert) -> None:
+        with self._lock:
+            for n in to_remove:
+                self._map.pop(int(n), None)
+            for n, v in to_upsert.items():
+                self._map[int(n)] = bytes(v)
+                if n > self._best:
+                    self._best = int(n)
+            if to_remove:
+                self._best = max(self._map.keys(), default=-1)
+
+    @property
+    def best_block_number(self) -> int:
+        return self._best
+
+    @property
+    def count(self) -> int:
+        return len(self._map)
+
+
+def verify_content_address(key: bytes, value: bytes) -> bool:
+    """Short-key collision guard (KesqueNodeDataSource.scala:61-63)."""
+    from khipu_tpu.base.crypto.keccak import keccak256
+
+    return keccak256(value) == key

@@ -1,0 +1,105 @@
+"""Storage façade: assembles all typed storages from an engine config.
+
+Parity: khipu-eth/.../storage/Storages.scala:6-81 (DefaultStorages:
+account/storage/evmcode NodeStorages, header/body/receipts/td block
+storages, blocknum, tx, appState; bestBlockNumber = min(bestBody,
+bestReceipts) :40; swithToWithUnconfirmed:46 / clearUnconfirmed:63 fan
+out to all) and ServiceBoard.scala:99-138 engine selection by
+``db.engine`` — engines here: ``memory`` | ``native`` (C++ append-log).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from khipu_tpu.storage.app_state import AppStateStorage
+from khipu_tpu.storage.block_storage import (
+    BlockBytesStorage,
+    BlockNumberStorage,
+    BlockNumbers,
+    TotalDifficultyStorage,
+    TransactionStorage,
+)
+from khipu_tpu.storage.datasource import (
+    MemoryBlockDataSource,
+    MemoryKeyValueDataSource,
+    MemoryNodeDataSource,
+)
+from khipu_tpu.storage.node_storage import NodeStorage
+
+
+class Storages:
+    def __init__(self, engine: str = "memory", data_dir: Optional[str] = None,
+                 unconfirmed_depth: int = 20, cache_size: int = 1 << 20):
+        self.engine = engine
+        if engine == "memory":
+            account_src = MemoryNodeDataSource()
+            storage_src = MemoryNodeDataSource()
+            evmcode_src = MemoryNodeDataSource()
+        elif engine == "native":
+            if data_dir is None:
+                raise ValueError("native engine requires data_dir")
+            try:
+                from khipu_tpu.native.store import NativeNodeDataSource
+            except ImportError as e:
+                raise NotImplementedError(
+                    "db.engine='native' requires the C++ append-log store "
+                    "(khipu_tpu/native/store.py) and a working g++"
+                ) from e
+            account_src = NativeNodeDataSource(data_dir, "account")
+            storage_src = NativeNodeDataSource(data_dir, "storage")
+            evmcode_src = NativeNodeDataSource(data_dir, "evmcode")
+        else:
+            raise ValueError(f"unknown db.engine {engine!r}")
+
+        self.account_node_storage = NodeStorage(
+            account_src, unconfirmed_depth, cache_size)
+        self.storage_node_storage = NodeStorage(
+            storage_src, unconfirmed_depth, cache_size)
+        self.evmcode_storage = NodeStorage(
+            evmcode_src, unconfirmed_depth, cache_size)
+
+        self.block_header_storage = BlockBytesStorage(MemoryBlockDataSource())
+        self.block_body_storage = BlockBytesStorage(MemoryBlockDataSource())
+        self.receipts_storage = BlockBytesStorage(MemoryBlockDataSource())
+        self.total_difficulty_storage = TotalDifficultyStorage(
+            MemoryBlockDataSource())
+        self.block_number_storage = BlockNumberStorage(
+            MemoryKeyValueDataSource())
+        self.block_numbers = BlockNumbers(self.block_number_storage)
+        self.transaction_storage = TransactionStorage(
+            MemoryKeyValueDataSource())
+        self.app_state = AppStateStorage(MemoryKeyValueDataSource())
+
+        self._node_storages = (
+            self.account_node_storage,
+            self.storage_node_storage,
+            self.evmcode_storage,
+        )
+
+    @property
+    def best_block_number(self) -> int:
+        """min(bestBody, bestReceipts) — Storages.scala:40."""
+        return min(
+            self.block_body_storage.best_block_number,
+            self.receipts_storage.best_block_number,
+        )
+
+    def switch_to_unconfirmed(self) -> None:
+        for s in self._node_storages:
+            s.switch_to_unconfirmed()
+
+    def clear_unconfirmed(self) -> None:
+        for s in self._node_storages:
+            s.clear_unconfirmed()
+
+    def flush(self) -> None:
+        for s in self._node_storages:
+            s.flush()
+
+    def stop(self) -> None:
+        self.flush()
+        for s in self._node_storages:
+            stop = getattr(s.source, "stop", None)
+            if stop:
+                stop()

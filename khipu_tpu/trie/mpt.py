@@ -36,11 +36,15 @@ Node = Union[bytes, List]  # b"" blank | [hp, v] | [c0..c15, v]
 Ref = Union[bytes, List]  # b"" | 32-byte hash | inline node
 
 BLANK: bytes = b""
-EMPTY_TRIE_HASH: bytes = keccak256(rlp_encode(b""))  # 56e81f17...b421
+# keccak256(rlp_encode(b"")) — a literal so importing this module never
+# triggers the lazy keccak binding (tests assert the equality).
+EMPTY_TRIE_HASH: bytes = bytes.fromhex(
+    "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+)
 
-# Change-log tags (khipu-base package.scala:12-19 Log/Updated/Removed ADT).
-UPDATED = "updated"
-REMOVED = "removed"
+# Change-log records are [net_refcount, encoded|None]: count > 0 is the
+# reference's Updated, < 0 Removed (khipu-base package.scala:12-19
+# Log/Updated/Removed ADT, refcounted for hash-aliased nodes).
 
 
 class MPTException(Exception):
@@ -82,7 +86,7 @@ class MerklePatriciaTrie:
         source,
         root_hash: Optional[bytes] = None,
         _root_ref: Optional[Ref] = None,
-        _logs: Optional[Dict[bytes, Tuple[str, Optional[bytes]]]] = None,
+        _logs: Optional[Dict[bytes, List]] = None,
         _staged: Optional[Dict[bytes, bytes]] = None,
     ):
         self.source = source
@@ -92,8 +96,8 @@ class MerklePatriciaTrie:
             self._root_ref = BLANK
         else:
             self._root_ref = bytes(root_hash)
-        # hash -> (tag, encoded|None); insertion-ordered
-        self._logs: Dict[bytes, Tuple[str, Optional[bytes]]] = dict(_logs or {})
+        # hash -> [net_refcount, encoded|None]; insertion-ordered
+        self._logs: Dict[bytes, List] = dict(_logs or {})
         # freshly created hash -> encoded, readable before persist
         self._staged: Dict[bytes, bytes] = dict(_staged or {})
 
@@ -143,7 +147,7 @@ class MerklePatriciaTrie:
         encoded = self._staged.get(ref)
         if encoded is None:
             log = self._logs.get(ref)
-            if log is not None and log[0] == UPDATED:
+            if log is not None and log[0] > 0:
                 encoded = log[1]
         if encoded is None:
             encoded = self.source.get(ref)
@@ -170,18 +174,24 @@ class MerklePatriciaTrie:
             return t
         old_ref = t._root_ref
         new_root = t._delete(root, bytes_to_nibbles(key))
+        if new_root == root:
+            return t  # key absent: pure no-op, log nothing
         t._root_ref = t._ref(new_root) if new_root != BLANK else BLANK
-        if t._root_ref != old_ref:
-            t._log_remove(old_ref)
+        t._log_remove(old_ref)
         return t
 
     def _child(self) -> "MerklePatriciaTrie":
-        return MerklePatriciaTrie(
-            self.source,
-            _root_ref=self._root_ref,
-            _logs=self._logs,
-            _staged=self._staged,
-        )
+        # Logs/staged are SHARED with the parent (not copied): a session
+        # accumulates one write-log across all mutations until persist(),
+        # so changes() reflects every mutation since the last persist no
+        # matter which returned trie it is called on. Old forks remain
+        # readable (_staged is append-only within a session). Copying
+        # here would cost O(n²) across n mutations.
+        t = MerklePatriciaTrie(self.source)
+        t._root_ref = self._root_ref
+        t._logs = self._logs
+        t._staged = self._staged
+        return t
 
     # Build a ref for a node, staging its encoding when it hashes
     # (capped rule, Node.scala:114: inline iff len(rlp) < 32).
@@ -196,26 +206,34 @@ class MerklePatriciaTrie:
         self._log_update(h, encoded)
         return h
 
+    # The log is REFCOUNTED per hash: identical subtrees under different
+    # parents alias one hash (content addressing), so a plain tag would
+    # drop the UPDATED record when only one of several referents goes
+    # away — silent data loss at persist. Net count > 0 ⇒ Updated,
+    # < 0 ⇒ Removed, == 0 ⇒ no net change (updateNodesToLogs dedup,
+    # MerklePatriciaTrie.scala:491-516; refcount idea: KesqueIndex's
+    # 16-bit refcount, KesqueIndex.scala:17-26).
+
     def _log_update(self, h: bytes, encoded: bytes) -> None:
-        prev = self._logs.get(h)
-        if prev is not None and prev[0] == REMOVED:
-            # removed then re-added ⇒ net original: drop both records
-            # (MerklePatriciaTrie.updateNodesToLogs dedup, :491-516)
-            del self._logs[h]
+        rec = self._logs.get(h)
+        if rec is None:
+            self._logs[h] = [1, encoded]
         else:
-            self._logs[h] = (UPDATED, encoded)
+            rec[0] += 1
+            rec[1] = encoded
+            if rec[0] == 0:
+                del self._logs[h]
 
     def _log_remove(self, ref: Ref) -> None:
         if not isinstance(ref, bytes) or ref == BLANK:
             return  # inline nodes were never stored
-        prev = self._logs.get(ref)
-        if prev is not None and prev[0] == UPDATED:
-            # Added then removed in the same session ⇒ net nothing.
-            # _staged is kept: identical subtrees can alias one hash
-            # from several parents, and it is only a session read cache.
-            del self._logs[ref]
+        rec = self._logs.get(ref)
+        if rec is None:
+            self._logs[ref] = [-1, None]
         else:
-            self._logs[ref] = (REMOVED, None)
+            rec[0] -= 1
+            if rec[0] == 0:
+                del self._logs[ref]
 
     # _insert/_delete take *resolved* nodes, return resolved nodes.
     def _insert(self, node: Node, nibbles: bytes, value: bytes) -> Node:
@@ -307,15 +325,15 @@ class MerklePatriciaTrie:
             if child == BLANK:
                 return node
             new_child = self._delete(child, nibbles[1:])
+            if new_child == child:
+                return node  # key absent below: pure no-op, log nothing
             new = list(node)
             if new_child == BLANK:
                 self._log_remove(child_ref)
                 new[nibbles[0]] = BLANK
                 return self._fix_branch(new)
-            new_ref = self._ref(new_child)
-            if new_ref != child_ref:
-                self._log_remove(child_ref)
-            new[nibbles[0]] = new_ref
+            self._log_remove(child_ref)
+            new[nibbles[0]] = self._ref(new_child)
             return new
 
         path, is_leaf = hp_decode(node[0])
@@ -327,13 +345,15 @@ class MerklePatriciaTrie:
         child_ref = node[1]
         child = self._resolve(child_ref)
         new_child = self._delete(child, nibbles[len(path) :])
+        if new_child == child:
+            return node  # no-op below: log nothing
+        self._log_remove(child_ref)
         if new_child == BLANK:
-            self._log_remove(child_ref)
             return BLANK
-        new_ref_candidate = self._ref(new_child)
-        if new_ref_candidate != child_ref:
-            self._log_remove(child_ref)
-        # merge with child if it became leaf/ext (fix, :431)
+        # merge with child if it became leaf/ext (fix, :431); the child
+        # is NOT _ref'd here — _merge_ext either refs it (branch) or
+        # absorbs it into this node (leaf/ext), so staging it would
+        # orphan a node no parent references.
         return self._merge_ext(path, new_child)
 
     def _merge_ext(self, path: bytes, child: Node) -> Node:
@@ -368,9 +388,9 @@ class MerklePatriciaTrie:
     def changes(self) -> Tuple[List[bytes], Dict[bytes, bytes]]:
         """(removed_hashes, {hash: encoded}) accumulated since the last
         persisted trie (MerklePatriciaTrie.changes:549)."""
-        removed = [h for h, (tag, _) in self._logs.items() if tag == REMOVED]
+        removed = [h for h, (count, _) in self._logs.items() if count < 0]
         upserts = {
-            h: enc for h, (tag, enc) in self._logs.items() if tag == UPDATED
+            h: enc for h, (count, enc) in self._logs.items() if count > 0
         }
         return removed, upserts
 

@@ -1,0 +1,47 @@
+"""Native C++ components vs pure-Python oracles."""
+
+import os
+import random
+
+import pytest
+
+from khipu_tpu.base.crypto.keccak import keccak256_py, keccak512_py
+from khipu_tpu.native import keccak as native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no native toolchain"
+)
+
+
+def test_native_keccak256_vs_oracle():
+    rng = random.Random(0)
+    for n in [0, 1, 55, 56, 135, 136, 137, 271, 272, 273, 576, 4096]:
+        data = rng.randbytes(n)
+        assert native.keccak256(data) == keccak256_py(data), n
+
+
+def test_native_keccak512_vs_oracle():
+    rng = random.Random(1)
+    for n in [0, 1, 71, 72, 73, 143, 144, 145, 576]:
+        data = rng.randbytes(n)
+        assert native.keccak512(data) == keccak512_py(data), n
+
+
+def test_native_keccak_known_vectors():
+    assert (
+        native.keccak256(b"").hex()
+        == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert (
+        native.keccak256(b"abc").hex()
+        == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+
+
+def test_native_batch_matches_singles():
+    rng = random.Random(2)
+    msgs = [rng.randbytes(rng.randint(0, 600)) for _ in range(257)]
+    assert native.keccak256_batch(msgs) == [
+        native.keccak256(m) for m in msgs
+    ]
+    assert native.keccak256_batch([]) == []

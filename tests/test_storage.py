@@ -1,0 +1,149 @@
+"""Storage layer: SPI engines, FIFO cache, unconfirmed ring, façade."""
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.storage import (
+    AppStateStorage,
+    FIFOCache,
+    MemoryBlockDataSource,
+    MemoryKeyValueDataSource,
+    MemoryNodeDataSource,
+    NodeStorage,
+    ReadOnlyNodeStorage,
+    SimpleMapWithUnconfirmed,
+    Storages,
+)
+from khipu_tpu.storage.block_storage import BlockNumbers, BlockNumberStorage
+from khipu_tpu.storage.datasource import verify_content_address
+from khipu_tpu.trie.mpt import MerklePatriciaTrie
+
+
+def test_fifo_cache_eviction_and_hit_rate():
+    c = FIFOCache(2)
+    c.put(b"a", 1)
+    c.put(b"b", 2)
+    c.put(b"c", 3)  # evicts a
+    assert c.get(b"a") is None
+    assert c.get(b"b") == 2
+    assert c.get(b"c") == 3
+    assert c.read_count == 3
+    assert abs(c.hit_rate - 2 / 3) < 1e-9
+
+
+def test_memory_kv_roundtrip():
+    s = MemoryKeyValueDataSource()
+    s.update([], {b"k1": b"v1", b"k2": b"v2"})
+    assert s.get(b"k1") == b"v1"
+    s.update([b"k1"], {})
+    assert s.get(b"k1") is None
+    assert s.count == 1
+
+
+def test_content_address_verify():
+    v = b"some node rlp"
+    assert verify_content_address(keccak256(v), v)
+    assert not verify_content_address(b"\x00" * 32, v)
+
+
+def test_block_data_source_best_number():
+    s = MemoryBlockDataSource()
+    assert s.best_block_number == -1
+    s.put(5, b"five")
+    s.put(3, b"three")
+    assert s.best_block_number == 5
+    assert s.get(3) == b"three"
+
+
+def test_unconfirmed_ring_trails_tip():
+    src = MemoryKeyValueDataSource()
+    ring = SimpleMapWithUnconfirmed(src, depth=3)
+    for i in range(5):  # 5 block batches, depth 3
+        ring.update([], {f"k{i}".encode(): f"v{i}".encode()})
+    # oldest 2 flushed, newest 3 buffered
+    assert src.get(b"k0") == b"v0" and src.get(b"k1") == b"v1"
+    assert src.get(b"k4") is None
+    assert ring.get(b"k4") == b"v4"  # visible through the ring
+    ring.clear_unconfirmed()  # reorg: buffered batches dropped
+    assert ring.get(b"k4") is None
+    assert ring.get(b"k0") == b"v0"
+
+
+def test_unconfirmed_flush_on_disable():
+    src = MemoryKeyValueDataSource()
+    ring = SimpleMapWithUnconfirmed(src, depth=10)
+    ring.update([], {b"a": b"1"})
+    assert src.get(b"a") is None
+    ring.set_buffering(False)
+    assert src.get(b"a") == b"1"
+    ring.update([], {b"b": b"2"})  # unbuffered: straight through
+    assert src.get(b"b") == b"2"
+
+
+def test_node_storage_never_deletes():
+    src = MemoryNodeDataSource()
+    ns = NodeStorage(src, cache_size=4)
+    h = keccak256(b"node")
+    ns.put(h, b"node")
+    ns.update([h], {})  # delete request swallowed
+    assert ns.get(h) == b"node"
+    assert src.get(h) == b"node"
+
+
+def test_node_storage_reorg_buffering():
+    src = MemoryNodeDataSource()
+    ns = NodeStorage(src, depth=2, cache_size=1024)
+    ns.switch_to_unconfirmed()
+    h = keccak256(b"x")
+    ns.update([], {h: b"x"})
+    assert src.get(h) is None  # still buffered
+    assert ns.get(h) == b"x"
+
+
+def test_readonly_node_storage_isolation():
+    src = MemoryNodeDataSource()
+    ro = ReadOnlyNodeStorage(src)
+    ro.put(b"k", b"v")
+    assert ro.get(b"k") == b"v"
+    assert src.get(b"k") is None
+
+
+def test_app_state_storage():
+    app = AppStateStorage(MemoryKeyValueDataSource())
+    assert app.best_block_number == 0
+    app.best_block_number = 123456
+    assert app.best_block_number == 123456
+    assert not app.fast_sync_done
+    app.mark_fast_sync_done()
+    assert app.fast_sync_done
+
+
+def test_block_numbers_bidirectional():
+    bn = BlockNumbers(BlockNumberStorage(MemoryKeyValueDataSource()))
+    h = keccak256(b"blk")
+    bn.put(h, 42)
+    assert bn.number_of(h) == 42
+    assert bn.hash_of(42) == h
+    bn.remove(h)
+    assert bn.number_of(h) is None
+
+
+def test_storages_facade_best_block_number():
+    st = Storages("memory")
+    st.block_body_storage.put(10, b"body")
+    st.receipts_storage.put(9, b"rcpt")
+    assert st.best_block_number == 9  # min(body, receipts)
+    st.switch_to_unconfirmed()
+    st.clear_unconfirmed()
+    st.stop()
+
+
+def test_mpt_over_node_storage():
+    """MPT persists through NodeStorage + unconfirmed ring, reopens."""
+    st = Storages("memory")
+    t = MerklePatriciaTrie(st.account_node_storage)
+    for i in range(50):
+        t = t.put(f"key{i}".encode(), f"value{i}".encode())
+    root = t.root_hash
+    t.persist()
+    reopened = MerklePatriciaTrie(st.account_node_storage, root_hash=root)
+    for i in range(50):
+        assert reopened.get(f"key{i}".encode()) == f"value{i}".encode()

@@ -174,7 +174,11 @@ def test_secure_trie_style_keys():
 
 
 def rlp_int(v: int) -> bytes:
-    return v.to_bytes((v.bit_length() + 7) // 8, "big") if v else b""
+    """Minimal big-endian scalar — an RLP list *item*, not an encoded
+    RLP string (so NOT rlp_encode_int, which adds the length prefix)."""
+    from khipu_tpu.base.rlp import int_to_big_endian
+
+    return int_to_big_endian(v)
 
 
 def genesis_alloc():
@@ -210,3 +214,23 @@ def test_mainnet_genesis_state_root_incremental_subset():
     for k, v in pairs:
         t = t.put(k, v)
     assert t.root_hash == bulk_build(pairs)[0]
+
+
+def test_hash_aliased_nodes_survive_removal():
+    """Two identical leaves alias one hash; removing one referent must
+    not drop the other's node from the persisted set (refcounted log)."""
+    src = DictSource()
+    t = MerklePatriciaTrie(src)
+    k1, k2, k3 = b"\x10" + b"\xaa" * 4, b"\x20" + b"\xaa" * 4, b"\x31" * 5
+    t = t.put(k1, b"V" * 40).put(k2, b"V" * 40).put(k3, b"W" * 40)
+    t = t.remove(k1)
+    root = t.root_hash
+    t.persist()
+    reopened = MerklePatriciaTrie(src, root_hash=root)
+    assert reopened.get(k2) == b"V" * 40  # was MPTNodeMissingException
+    assert reopened.get(k3) == b"W" * 40
+    assert reopened.get(k1) is None
+
+
+def test_empty_trie_hash_literal():
+    assert EMPTY_TRIE_HASH == keccak256(rlp_encode(b""))
