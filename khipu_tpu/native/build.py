@@ -46,13 +46,22 @@ def load_library() -> Optional[ctypes.CDLL]:
             return _lib
         try:
             if _needs_build():
+                # Compile to a process-unique temp path and os.replace()
+                # into place: concurrent builders (pytest-xdist, multi-
+                # process runs) must never dlopen a half-written .so.
+                tmp = f"{_OUT}.{os.getpid()}.tmp"
                 cmd = [
                     "g++", "-O3", "-march=native", "-shared", "-fPIC",
-                    "-std=c++17", "-o", _OUT, *_sources(),
+                    "-std=c++17", "-o", tmp, *_sources(),
                 ]
-                subprocess.run(
-                    cmd, check=True, capture_output=True, timeout=300
-                )
+                try:
+                    subprocess.run(
+                        cmd, check=True, capture_output=True, timeout=300
+                    )
+                    os.replace(tmp, _OUT)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
             _lib = ctypes.CDLL(_OUT)
         except Exception:
             _failed = True

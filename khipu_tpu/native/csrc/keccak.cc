@@ -27,7 +27,8 @@ constexpr uint64_t kRC[24] = {
     0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
 
 inline uint64_t rotl(uint64_t x, int s) {
-  return (x << s) | (x >> (64 - s));
+  // s == 0 occurs (kRho[0]); x >> 64 would be undefined behavior.
+  return s ? (x << s) | (x >> (64 - s)) : x;
 }
 
 void keccak_f1600(uint64_t a[25]) {

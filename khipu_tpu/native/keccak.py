@@ -39,6 +39,12 @@ def available() -> bool:
 
 def _digest(data: bytes, rate: int, out_len: int) -> bytes:
     lib = _get_lib()
+    if lib is None:
+        # Same self-healing as keccak256_batch: pure sponge directly
+        # (base.crypto.keccak's public fns may be bound to this module).
+        from khipu_tpu.base.crypto.keccak import keccak256_py, keccak512_py
+
+        return keccak256_py(data) if rate == _RATE_256 else keccak512_py(data)
     out = ctypes.create_string_buffer(out_len)
     lib.khipu_keccak(rate, bytes(data), len(data), out, out_len)
     return out.raw
@@ -57,6 +63,12 @@ def keccak256_batch(messages: Sequence[bytes]) -> List[bytes]:
     n = len(messages)
     if n == 0:
         return []
+    if lib is None:
+        # Use the pure sponge directly — base.crypto.keccak.keccak256
+        # may itself be bound to this module (circular).
+        from khipu_tpu.base.crypto.keccak import keccak256_py
+
+        return [keccak256_py(m) for m in messages]
     blob = b"".join(messages)
     offsets = (ctypes.c_uint64 * (n + 1))()
     pos = 0

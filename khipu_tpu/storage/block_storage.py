@@ -90,8 +90,13 @@ class TransactionStorage:
 class BlockNumbers:
     """RW-locked bidirectional number<->hash maps (BlockNumbers.scala:9)."""
 
-    def __init__(self, block_number_storage: BlockNumberStorage):
+    def __init__(
+        self,
+        block_number_storage: BlockNumberStorage,
+        block_header_storage: Optional[BlockBytesStorage] = None,
+    ):
         self._storage = block_number_storage
+        self._headers = block_header_storage
         self._num_to_hash: Dict[int, bytes] = {}
         self._hash_to_num: Dict[bytes, int] = {}
         self._lock = threading.RLock()
@@ -110,7 +115,29 @@ class BlockNumbers:
 
     def hash_of(self, number: int) -> Optional[bytes]:
         with self._lock:
-            return self._num_to_hash.get(number)
+            h = self._num_to_hash.get(number)
+        if h is not None:
+            return h
+        # Storage fallback (getHashByBlockNumber, BlockNumbers.scala):
+        # after a restart the in-memory maps are empty; derive the hash
+        # from the persisted header (hash == keccak256(header rlp)).
+        if self._headers is None:
+            return None
+        header = self._headers.get(number)
+        if header is None:
+            return None
+        from khipu_tpu.base.crypto.keccak import keccak256
+
+        h = keccak256(header)
+        # Trust the derived hash only while the hash->number record still
+        # exists: after remove() (reorg orphaning) the stale header must
+        # not resurrect the mapping.
+        if self._storage.get(h) != number:
+            return None
+        with self._lock:
+            self._num_to_hash[number] = h
+            self._hash_to_num[h] = number
+        return h
 
     def put(self, block_hash: bytes, number: int) -> None:
         self._storage.put(block_hash, number)

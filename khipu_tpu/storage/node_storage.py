@@ -49,7 +49,13 @@ class NodeStorage:
         self._unconfirmed.set_buffering(True)
 
     def clear_unconfirmed(self) -> None:
-        self._unconfirmed.clear_unconfirmed()
+        # The FIFO cache is populated by update()/get() with unconfirmed
+        # values; dropping the ring without evicting those keys would
+        # keep serving nodes that were never durably written (and mask
+        # MPTNodeMissingException after a reorg + restart). Evict only
+        # the dropped keys — confirmed hot nodes stay cached.
+        for key in self._unconfirmed.clear_unconfirmed():
+            self._cache.remove(key)
 
     def flush(self) -> None:
         self._unconfirmed.flush()

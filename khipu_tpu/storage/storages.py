@@ -66,7 +66,8 @@ class Storages:
             MemoryBlockDataSource())
         self.block_number_storage = BlockNumberStorage(
             MemoryKeyValueDataSource())
-        self.block_numbers = BlockNumbers(self.block_number_storage)
+        self.block_numbers = BlockNumbers(
+            self.block_number_storage, self.block_header_storage)
         self.transaction_storage = TransactionStorage(
             MemoryKeyValueDataSource())
         self.app_state = AppStateStorage(MemoryKeyValueDataSource())

@@ -15,7 +15,7 @@ import threading
 from collections import deque
 from typing import Deque, Dict, Iterable, List, Mapping, Optional, Tuple
 
-Batch = Tuple[List[bytes], Dict[bytes, bytes]]  # (removes, upserts)
+Batch = Tuple[frozenset, Dict[bytes, bytes]]  # (removes, upserts)
 
 
 class SimpleMapWithUnconfirmed:
@@ -57,7 +57,7 @@ class SimpleMapWithUnconfirmed:
     ) -> None:
         """One call == one block's batch (update:24-40)."""
         batch: Batch = (
-            [bytes(k) for k in to_remove],
+            frozenset(bytes(k) for k in to_remove),
             {bytes(k): bytes(v) for k, v in to_upsert.items()},
         )
         with self._lock:
@@ -73,9 +73,16 @@ class SimpleMapWithUnconfirmed:
             while self._queue:
                 self.source.update(*self._queue.popleft())
 
-    def clear_unconfirmed(self) -> None:
+    def clear_unconfirmed(self) -> List[bytes]:
+        """Drop all buffered batches; returns the keys they touched so
+        callers can invalidate read caches selectively."""
         with self._lock:
+            dropped: List[bytes] = []
+            for removes, upserts in self._queue:
+                dropped.extend(removes)
+                dropped.extend(upserts.keys())
             self._queue.clear()
+            return dropped
 
     @property
     def pending_batches(self) -> int:

@@ -98,6 +98,43 @@ def test_node_storage_reorg_buffering():
     assert ns.get(h) == b"x"
 
 
+def test_node_storage_reorg_drops_cached_unconfirmed():
+    """After a reorg (clear_unconfirmed), nodes that only ever lived in
+    the unconfirmed ring must be gone — including from the read cache —
+    so MPTNodeMissingException can drive a re-fetch (ADVICE r1 medium)."""
+    src = MemoryNodeDataSource()
+    ns = NodeStorage(src, depth=4, cache_size=1024)
+    ns.switch_to_unconfirmed()
+    h = keccak256(b"orphan")
+    ns.update([], {h: b"orphan"})
+    assert ns.get(h) == b"orphan"  # populates the cache
+    ns.clear_unconfirmed()
+    assert ns.get(h) is None
+
+
+def test_block_numbers_header_storage_fallback():
+    """hash_of falls back to the persisted header after a 'restart'
+    (fresh BlockNumbers over the same storages) — BlockNumbers.scala
+    getHashByBlockNumber semantics."""
+    from khipu_tpu.storage.block_storage import BlockBytesStorage
+    from khipu_tpu.storage.datasource import MemoryBlockDataSource
+
+    headers = BlockBytesStorage(MemoryBlockDataSource())
+    header_rlp = b"\xc3\x01\x02\x03"
+    headers.put(7, header_rlp)
+    nums = BlockNumberStorage(MemoryKeyValueDataSource())
+    nums.put(keccak256(header_rlp), 7)  # persisted pre-"restart"
+    bn = BlockNumbers(nums, headers)  # fresh maps = post-restart state
+    assert bn.hash_of(7) == keccak256(header_rlp)
+    assert bn.number_of(keccak256(header_rlp)) == 7
+    assert bn.hash_of(8) is None
+    # A removed (orphaned) mapping must NOT be resurrected from the
+    # stale header left in block storage.
+    bn2 = BlockNumbers(nums, headers)
+    bn2.remove(keccak256(header_rlp))
+    assert bn2.hash_of(7) is None
+
+
 def test_readonly_node_storage_isolation():
     src = MemoryNodeDataSource()
     ro = ReadOnlyNodeStorage(src)
