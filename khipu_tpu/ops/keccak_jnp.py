@@ -119,8 +119,12 @@ def absorb(blocks: jax.Array, nblocks: int) -> jax.Array:
             interleaved as [lo0, hi0, lo1, hi1, ...], batch minor.
     returns: uint32[8, B] — digest words [lo0, hi0, .., lo3, hi3].
     """
-    batch_shape = blocks.shape[2:]
-    zero = jnp.zeros(batch_shape, jnp.uint32)
+    # Derive the zero state from the input (x ^ x) rather than
+    # jnp.zeros: under shard_map the capacity lanes (17-24, never
+    # absorbed) must carry the same varying-over-mesh-axis type as the
+    # data lanes or the fori_loop carry fails vma typechecking; XLA
+    # folds x^x to 0 so this costs nothing.
+    zero = blocks[0, 0] ^ blocks[0, 0]
     lo = [zero] * 25
     hi = [zero] * 25
     for b in range(nblocks):

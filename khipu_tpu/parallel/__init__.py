@@ -1,0 +1,28 @@
+"""Multi-chip parallelism: device meshes + sharded hashing/verification.
+
+The reference scales across hosts with Akka Cluster Sharding of trie
+nodes (entity/NodeEntity.scala:28, storage/DistributedNodeStorage.scala:13)
+and cluster-singleton services. The TPU-native analog (SURVEY §2.8
+mapping (b)/(c)) is data-parallel sharding of node batches over a
+``jax.sharding.Mesh`` with XLA collectives over ICI:
+
+* hash a level's dirty nodes sharded across chips (`shard_map`),
+* ``all_gather`` the level's digests at level boundaries so every chip
+  can resolve parent references (the bulk-build "sequence parallelism"
+  of SURVEY §5.7),
+* ``psum`` mismatch counts for snapshot verification (config #5).
+"""
+
+from khipu_tpu.parallel.mesh import device_mesh
+from khipu_tpu.parallel.keccak_sharded import (
+    hash_level_all_gather,
+    keccak256_fixed_sharded,
+    snapshot_verify_sharded,
+)
+
+__all__ = [
+    "device_mesh",
+    "hash_level_all_gather",
+    "keccak256_fixed_sharded",
+    "snapshot_verify_sharded",
+]

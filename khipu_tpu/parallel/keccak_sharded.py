@@ -1,0 +1,177 @@
+"""Sharded batched Keccak-256 + snapshot verification over a device mesh.
+
+Replaces the reference's distributed node cache / multi-host story
+(DistributedNodeStorage.scala:13, NodeEntity.scala:28) with SPMD over a
+``Mesh``: the node batch is split evenly across chips, each chip runs
+the same batched sponge on its shard, and XLA collectives stitch the
+results — ``all_gather`` for level boundaries of the bulk trie build,
+``psum`` for fast-sync snapshot-verify mismatch counts (config #5).
+
+All functions accept fixed-length (one size class) node batches; the
+variable-length entry points in ops/keccak.py bucket into size classes
+first, so sharding composes with bucketing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from khipu_tpu.ops.keccak_jnp import LANES_PER_BLOCK, RATE, absorb
+from khipu_tpu.parallel.mesh import AXIS, pad_to_shards
+
+
+def _fixed_digests(data_u8: jax.Array, length: int) -> jax.Array:
+    """Device-side pad + pack + hash: uint8[B, length] -> uint8[B, 32].
+
+    Traceable (no host work), so it can run inside jit / shard_map on
+    any backend. Multi-rate padding appends ``nblocks*RATE - length``
+    bytes with 0x01 first and 0x80 last (xor-combined when they
+    coincide).
+    """
+    n = data_u8.shape[0]
+    nblocks = length // RATE + 1
+    tail = np.zeros(nblocks * RATE - length, dtype=np.uint8)
+    tail[0] ^= 0x01
+    tail[-1] ^= 0x80
+    padded = jnp.concatenate(
+        [data_u8, jnp.broadcast_to(jnp.asarray(tail), (n, tail.shape[0]))],
+        axis=1,
+    )
+    nwords = nblocks * 2 * LANES_PER_BLOCK
+    w = jax.lax.bitcast_convert_type(
+        padded.reshape(n, nwords, 4), jnp.uint32
+    )  # (B, nwords), little-endian
+    blocks = w.reshape(n, nblocks, 2 * LANES_PER_BLOCK).transpose(1, 2, 0)
+    words = absorb(blocks, nblocks)  # (8, B)
+    return jax.lax.bitcast_convert_type(
+        words.T, jnp.uint8
+    ).reshape(n, 32)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_sharded_hash(length: int, mesh: Mesh):
+    """jit(shard_map(hash-my-shard)): batch dim split on the nodes axis."""
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(AXIS, None),
+        out_specs=P(AXIS, None),
+    )
+    def hash_shard(shard):  # uint8[B/n_dev, length]
+        return _fixed_digests(shard, length)
+
+    return jax.jit(hash_shard)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_level_all_gather(length: int, mesh: Mesh):
+    """Hash my shard, then all_gather the level's digests: every chip
+    ends with the full digest table for the level, which is what lets
+    chip-local parents of the NEXT level resolve children hashed on
+    other chips (the level-boundary collective of SURVEY §2.8(c))."""
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(AXIS, None),
+        out_specs=P(None, None),  # replicated full table
+        # all_gather(tiled) yields identical values on every device, but
+        # the vma checker can't infer that replication statically.
+        check_vma=False,
+    )
+    def level_shard(shard):
+        digests = _fixed_digests(shard, length)
+        return jax.lax.all_gather(digests, AXIS, tiled=True)
+
+    return jax.jit(level_shard)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_sharded_verify(length: int, mesh: Mesh):
+    """Content-address check, sharded: each chip re-hashes its nodes and
+    compares against the claimed keys; a psum over the mesh yields the
+    global mismatch count (KesqueNodeDataSource.scala:61-63 semantics at
+    fast-sync snapshot scale, config #5)."""
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS, None)),
+        out_specs=P(),  # replicated scalar
+    )
+    def verify_shard(vals, keys):
+        digests = _fixed_digests(vals, length)
+        bad = jnp.any(digests != keys, axis=1).astype(jnp.int32)
+        return jax.lax.psum(jnp.sum(bad), AXIS)
+
+    return jax.jit(verify_shard)
+
+
+def _pad_batch(
+    arr: np.ndarray, n_shards: int, fill_row: Optional[np.ndarray] = None
+) -> Tuple[np.ndarray, int]:
+    n = arr.shape[0]
+    target = pad_to_shards(n, n_shards, floor=n_shards)
+    if target == n:
+        return arr, n
+    pad = np.zeros((target - n,) + arr.shape[1:], dtype=arr.dtype)
+    if fill_row is not None:
+        pad[:] = fill_row
+    return np.concatenate([arr, pad], axis=0), n
+
+
+def keccak256_fixed_sharded(data: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """Hash N equal-length messages across the mesh: uint8[N, L] -> uint8[N, 32]."""
+    n_shards = mesh.devices.size
+    padded, n = _pad_batch(np.ascontiguousarray(data, dtype=np.uint8), n_shards)
+    with mesh:
+        out = _build_sharded_hash(data.shape[1], mesh)(jnp.asarray(padded))
+    return np.asarray(jax.device_get(out))[:n]
+
+
+def hash_level_all_gather(data: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """Hash one trie level's nodes sharded; return the replicated digest
+    table (as the host sees it: uint8[N, 32])."""
+    n_shards = mesh.devices.size
+    padded, n = _pad_batch(np.ascontiguousarray(data, dtype=np.uint8), n_shards)
+    with mesh:
+        out = _build_level_all_gather(data.shape[1], mesh)(jnp.asarray(padded))
+    return np.asarray(jax.device_get(out))[:n]
+
+
+def snapshot_verify_sharded(
+    values: np.ndarray, keys: np.ndarray, mesh: Mesh
+) -> int:
+    """Global count of nodes whose keccak256(value) != key.
+
+    Batch-padding rows are made self-consistent (their true digest) so
+    they never count as mismatches.
+    """
+    if values.shape[0] != keys.shape[0]:
+        raise ValueError("values/keys batch mismatch")
+    n_shards = mesh.devices.size
+    values = np.ascontiguousarray(values, dtype=np.uint8)
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    padded_vals, n = _pad_batch(values, n_shards)
+    if padded_vals.shape[0] != n:
+        from khipu_tpu.base.crypto.keccak import keccak256
+
+        zero_digest = np.frombuffer(
+            keccak256(b"\x00" * values.shape[1]), dtype=np.uint8
+        )
+        padded_keys, _ = _pad_batch(keys, n_shards, fill_row=zero_digest)
+    else:
+        padded_keys = keys
+    with mesh:
+        out = _build_sharded_verify(values.shape[1], mesh)(
+            jnp.asarray(padded_vals), jnp.asarray(padded_keys)
+        )
+    return int(jax.device_get(out))
