@@ -151,14 +151,17 @@ def pubkey_to_address(pubkey_xy: bytes) -> bytes:
 # ------------------------------------------------------------------- sign
 
 
-def _rfc6979_k(msg_hash: bytes, priv: bytes) -> int:
-    """Deterministic nonce (RFC 6979, HMAC-SHA256) — what geth/parity
-    use, so fixture signatures are reproducible across runs."""
+def _rfc6979_gen(msg_hash: bytes, priv: bytes):
+    """Deterministic nonce stream (RFC 6979 §3.2, HMAC-SHA256) — what
+    geth/parity use, so fixture signatures are reproducible across runs.
+    Yields candidate k values; the caller advances the generator (the
+    §3.2.h K/V update) when a candidate produces r == 0 or s == 0.
+    Per §2.3.4/§3.2, h1 enters the HMAC as bits2octets = int(h1) mod N."""
     holen = 32
     V = b"\x01" * holen
     K = b"\x00" * holen
     x = priv.rjust(32, b"\x00")
-    h1 = msg_hash
+    h1 = (int.from_bytes(msg_hash, "big") % N).to_bytes(32, "big")
     K = hmac.new(K, V + b"\x00" + x + h1, hashlib.sha256).digest()
     V = hmac.new(K, V, hashlib.sha256).digest()
     K = hmac.new(K, V + b"\x01" + x + h1, hashlib.sha256).digest()
@@ -167,7 +170,7 @@ def _rfc6979_k(msg_hash: bytes, priv: bytes) -> int:
         V = hmac.new(K, V, hashlib.sha256).digest()
         k = int.from_bytes(V, "big")
         if 0 < k < N:
-            return k
+            yield k
         K = hmac.new(K, V + b"\x00", hashlib.sha256).digest()
         V = hmac.new(K, V, hashlib.sha256).digest()
 
@@ -181,16 +184,13 @@ def ecdsa_sign(msg_hash: bytes, priv: bytes) -> Tuple[int, int, int]:
     if not 0 < d < N:
         raise SignatureError("private key out of range")
     z = int.from_bytes(msg_hash, "big")
-    while True:
-        k = _rfc6979_k(msg_hash, priv)
+    for k in _rfc6979_gen(msg_hash, priv):
         R = _from_jacobian(_j_mul(_G, k))
         r = R[0] % N
         if r == 0:
-            msg_hash = hashlib.sha256(msg_hash).digest()
-            continue
+            continue  # next k from the RFC 6979 K/V update loop
         s = (pow(k, -1, N) * (z + r * d)) % N
         if s == 0:
-            msg_hash = hashlib.sha256(msg_hash).digest()
             continue
         recid = (R[1] & 1) | (2 if R[0] >= N else 0)
         if s > HALF_N:

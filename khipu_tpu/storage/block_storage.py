@@ -106,9 +106,12 @@ class BlockNumbers:
             n = self._hash_to_num.get(block_hash)
         if n is not None:
             return n
-        n = self._storage.get(block_hash)
-        if n is not None:
-            with self._lock:
+        # Re-check the storage under the lock before caching, as in
+        # hash_of: a remove() between an unlocked read and the insert
+        # would resurrect a reorg-orphaned mapping.
+        with self._lock:
+            n = self._storage.get(block_hash)
+            if n is not None:
                 self._hash_to_num[block_hash] = n
                 self._num_to_hash[n] = block_hash
         return n
@@ -131,10 +134,12 @@ class BlockNumbers:
         h = keccak256(header)
         # Trust the derived hash only while the hash->number record still
         # exists: after remove() (reorg orphaning) the stale header must
-        # not resurrect the mapping.
-        if self._storage.get(h) != number:
-            return None
+        # not resurrect the mapping. The storage re-check happens under
+        # the lock so a concurrent remove() cannot interleave between the
+        # verification and the map insert.
         with self._lock:
+            if self._storage.get(h) != number:
+                return None
             self._num_to_hash[number] = h
             self._hash_to_num[h] = number
         return h

@@ -73,10 +73,6 @@ class FIFOCache(Generic[K, V]):
         with self._lock:
             self._map.pop(key, None)
 
-    def clear(self) -> None:
-        with self._lock:
-            self._map.clear()
-
     def __len__(self) -> int:
         return len(self._map)
 

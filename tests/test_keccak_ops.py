@@ -39,6 +39,12 @@ class TestJnpBatch:
 
 
 class TestPallasInterpret:
+    """Interpret-mode emulation of the kernel: minutes per tile on CPU,
+    so marked slow (run with `pytest -m slow`). The round permutation
+    itself (_round/_RC32) is fast-tested through the jnp path above,
+    which the Pallas kernel shares verbatim."""
+
+    @pytest.mark.slow
     def test_one_block_class_vs_oracle(self):
         from khipu_tpu.ops.keccak_pallas import keccak256_batch_pallas
 
@@ -48,6 +54,7 @@ class TestPallasInterpret:
         for g, m in zip(got, msgs):
             assert g == keccak256(m), f"len={len(m)}"
 
+    @pytest.mark.slow
     def test_fixed_path_vs_oracle(self):
         from khipu_tpu.ops.keccak_pallas import keccak256_fixed
 
@@ -57,3 +64,28 @@ class TestPallasInterpret:
         assert out.shape == (6, 32)
         for i in range(6):
             assert out[i].tobytes() == keccak256(data[i].tobytes())
+
+
+class TestPallasLayout:
+    """Numpy-only checks of the Pallas host-side layout logic (retile and
+    its inverse indexing) — the kernel-independent part that interpret
+    mode would otherwise be the only off-TPU coverage for."""
+
+    def test_retile_roundtrip_indexing(self):
+        from khipu_tpu.ops.keccak_pallas import TILE, retile
+
+        rng = np.random.default_rng(11)
+        nblocks, batch = 2, 2 * TILE
+        blocks = rng.integers(0, 2**32, size=(nblocks, 34, batch), dtype=np.uint64
+                              ).astype(np.uint32)
+        tiled = retile(blocks)
+        assert tiled.shape == (batch // TILE, nblocks * 34, 8, 128)
+        # message j's word w must land at [j // TILE, w, (j % TILE) // 128,
+        # j % 128] — the exact inverse used by keccak256_batch_pallas.
+        for j in (0, 1, 127, 128, 1023, 1024, 2047):
+            t, r = divmod(j, TILE)
+            s, l = divmod(r, 128)
+            np.testing.assert_array_equal(
+                tiled[t, :, s, l],
+                blocks.reshape(nblocks * 34, batch)[:, j],
+            )

@@ -1,0 +1,135 @@
+"""secp256k1 ECDSA tests (SURVEY.md §4 unit-test plan; parity target
+khipu-eth/.../crypto/ECDSASignature.scala:115 recover, :480 sign).
+
+The EIP-155 example transaction is the golden vector: signing hash,
+deterministic r/s under RFC 6979, and sender-address recovery must all
+match the published values.
+"""
+
+import pytest
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.base.crypto.secp256k1 import (
+    HALF_N,
+    N,
+    SignatureError,
+    ecdsa_recover,
+    ecdsa_sign,
+    ecdsa_verify,
+    is_on_curve,
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.base.rlp import rlp_encode
+
+# EIP-155 example: nonce=9, gasprice=20 gwei, gas=21000,
+# to=0x3535...35, value=1 ether, chainId=1, priv=0x46..46.
+EIP155_PRIV = bytes.fromhex(
+    "4646464646464646464646464646464646464646464646464646464646464646"
+)
+EIP155_SIGNING_HASH = bytes.fromhex(
+    "daf5a779ae972f972197303d7b574746c7ef83eadac0f2791ad23db92e4c8e53"
+)
+EIP155_R = 18515461264373351373200002665853028612451056578545711640558177340181847433846
+EIP155_S = 46948507304638947509940763649030358759909902576025900602547168820602576006531
+EIP155_V = 37  # chain_id 1, parity 0 -> 35 + 0
+
+
+def eip155_signing_payload():
+    def i2b(n):
+        return n.to_bytes((n.bit_length() + 7) // 8, "big") if n else b""
+
+    return rlp_encode(
+        [
+            i2b(9),
+            i2b(20 * 10**9),
+            i2b(21000),
+            bytes.fromhex("3535353535353535353535353535353535353535"),
+            i2b(10**18),
+            b"",
+            i2b(1),  # chain id
+            b"",
+            b"",
+        ]
+    )
+
+
+class TestEIP155Vector:
+    def test_signing_hash(self):
+        assert keccak256(eip155_signing_payload()) == EIP155_SIGNING_HASH
+
+    def test_deterministic_signature(self):
+        recid, r, s = ecdsa_sign(EIP155_SIGNING_HASH, EIP155_PRIV)
+        assert r == EIP155_R
+        assert s == EIP155_S
+        assert 35 + 2 * 1 + recid == EIP155_V
+
+    def test_recover_matches_signer(self):
+        pub = privkey_to_pubkey(EIP155_PRIV)
+        recid, r, s = ecdsa_sign(EIP155_SIGNING_HASH, EIP155_PRIV)
+        rec = ecdsa_recover(EIP155_SIGNING_HASH, recid, r, s)
+        assert rec == pub
+        assert pubkey_to_address(rec) == pubkey_to_address(pub)
+
+
+class TestSignRecoverVerify:
+    def test_round_trips(self):
+        for i in range(1, 6):
+            priv = i.to_bytes(32, "big")
+            pub = privkey_to_pubkey(priv)
+            assert is_on_curve(
+                (int.from_bytes(pub[:32], "big"), int.from_bytes(pub[32:], "big"))
+            )
+            msg = keccak256(b"khipu" + bytes([i]))
+            recid, r, s = ecdsa_sign(msg, priv)
+            assert ecdsa_recover(msg, recid, r, s) == pub
+            assert ecdsa_verify(msg, pub, r, s)
+
+    def test_low_s_enforced(self):
+        for i in range(1, 20):
+            msg = keccak256(bytes([i]) * 7)
+            _, _, s = ecdsa_sign(msg, (i * 7919).to_bytes(32, "big"))
+            assert 0 < s <= HALF_N
+
+    def test_wrong_message_does_not_verify(self):
+        priv = (42).to_bytes(32, "big")
+        pub = privkey_to_pubkey(priv)
+        msg = keccak256(b"a")
+        recid, r, s = ecdsa_sign(msg, priv)
+        assert not ecdsa_verify(keccak256(b"b"), pub, r, s)
+        assert ecdsa_recover(keccak256(b"b"), recid, r, s) != pub
+
+
+class TestInvalidInputs:
+    def test_recid_out_of_range(self):
+        with pytest.raises(SignatureError):
+            ecdsa_recover(b"\x01" * 32, 4, 1, 1)
+
+    def test_r_s_out_of_range(self):
+        for r, s in ((0, 1), (1, 0), (N, 1), (1, N)):
+            with pytest.raises(SignatureError):
+                ecdsa_recover(b"\x01" * 32, 0, r, s)
+            assert not ecdsa_verify(b"\x01" * 32, b"\x00" * 64, r, s)
+
+    def test_r_not_on_curve(self):
+        # x = 5 has no curve point with the tested parity... pick an x
+        # known to be a non-residue: search deterministically.
+        from khipu_tpu.base.crypto.secp256k1 import P
+
+        x = next(
+            x
+            for x in range(2, 50)
+            if pow((pow(x, 3, P) + 7) % P, (P - 1) // 2, P) != 1
+        )
+        with pytest.raises(SignatureError):
+            ecdsa_recover(b"\x01" * 32, 0, x, 1)
+
+    def test_bad_hash_length(self):
+        with pytest.raises(SignatureError):
+            ecdsa_sign(b"\x01" * 31, (1).to_bytes(32, "big"))
+
+    def test_bad_priv(self):
+        with pytest.raises(SignatureError):
+            ecdsa_sign(b"\x01" * 32, b"\x00" * 32)
+        with pytest.raises(SignatureError):
+            ecdsa_sign(b"\x01" * 32, N.to_bytes(32, "big"))
