@@ -1,0 +1,108 @@
+"""Configuration tree — one dataclass hierarchy for the whole node.
+
+Parity: config/KhipuConfig.scala:20-120 (nested Network/Sync/Db accessor
+objects over HOCON) and BlockchainConfig :185 (fork block numbers,
+chainId, accountStartNonce, monetary policy), DbConfig.scala:5-40
+(engine enum). HOCON cake traits become plain frozen dataclasses; every
+branch exposed here is implemented (engine names match
+khipu_tpu.storage.storages.Storages).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+FAR = 1 << 62  # "fork not scheduled" sentinel block number
+
+
+@dataclass(frozen=True)
+class MonetaryPolicy:
+    """Block reward eras (BlockRewardCalculator.scala:11 — ETH forks)."""
+
+    frontier_reward: int = 5 * 10**18
+    byzantium_reward: int = 3 * 10**18  # EIP-649
+    constantinople_reward: int = 2 * 10**18  # EIP-1234
+
+
+@dataclass(frozen=True)
+class BlockchainConfig:
+    """Fork schedule + chain constants (BlockchainConfig, KhipuConfig.scala:185).
+
+    Defaults are Ethereum mainnet numbers; fixtures construct compressed
+    schedules (e.g. all forks at 0) for targeted testing.
+    """
+
+    chain_id: int = 1
+    account_start_nonce: int = 0
+    # fork activation block numbers
+    homestead_block: int = 1_150_000
+    eip150_block: int = 2_463_000
+    eip155_block: int = 2_675_000  # also EIP-160/161 (Spurious Dragon)
+    eip160_block: int = 2_675_000
+    eip161_block: int = 2_675_000
+    # one-block mainnet patch: blocks where EIP-161 state clearing was
+    # retro-disabled (EvmConfig.scala:111-118 eip161PatchBlockNumber)
+    eip161_patch_block: int = FAR
+    eip170_block: int = 2_675_000  # max code size
+    byzantium_block: int = 4_370_000
+    constantinople_block: int = 7_280_000
+    petersburg_block: int = 7_280_000
+    istanbul_block: int = 9_069_000
+    # difficulty bomb delays (DifficultyCalculator.scala:17)
+    bomb_pause_block: int = 4_370_000  # EIP-649 (-3M)
+    bomb_defuse_block: int = FAR
+    monetary_policy: MonetaryPolicy = field(default_factory=MonetaryPolicy)
+    max_code_size: int = 24_576  # EIP-170
+    gas_tie_breaker: bool = False
+
+
+@dataclass(frozen=True)
+class DbConfig:
+    """Engine selection (DbConfig.scala:5-19): the values here are the
+    engines Storages actually dispatches on."""
+
+    engine: str = "memory"  # memory | native
+    data_dir: Optional[str] = None
+    cache_size: int = 1 << 20  # node FIFO cache entries (cache-size)
+    unconfirmed_depth: int = 20  # block-resolving-depth reorg ring
+
+
+@dataclass(frozen=True)
+class SyncConfig:
+    """Replay/sync knobs (KhipuConfig.Sync)."""
+
+    block_resolving_depth: int = 20
+    parallel_tx: bool = True  # optimistic parallel execution (P1)
+    tx_workers: int = 8  # worker pool width (TxProcessor.scala:29 role)
+    commit_window_blocks: int = 1  # blocks batched per TPU trie commit
+
+
+@dataclass(frozen=True)
+class KhipuConfig:
+    blockchain: BlockchainConfig = field(default_factory=BlockchainConfig)
+    db: DbConfig = field(default_factory=DbConfig)
+    sync: SyncConfig = field(default_factory=SyncConfig)
+
+
+def fixture_config(
+    chain_id: int = 1, fork_block: int = 0, **overrides
+) -> KhipuConfig:
+    """A compressed schedule with every fork active from ``fork_block`` —
+    what fixture chains use so modern semantics apply from genesis."""
+    kwargs = dict(
+        chain_id=chain_id,
+        homestead_block=fork_block,
+        eip150_block=fork_block,
+        eip155_block=fork_block,
+        eip160_block=fork_block,
+        eip161_block=fork_block,
+        eip170_block=fork_block,
+        byzantium_block=fork_block,
+        constantinople_block=fork_block,
+        petersburg_block=fork_block,
+        istanbul_block=fork_block,
+        bomb_pause_block=fork_block,
+    )
+    kwargs.update(overrides)
+    return KhipuConfig(blockchain=BlockchainConfig(**kwargs))

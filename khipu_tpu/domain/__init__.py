@@ -1,0 +1,27 @@
+"""Domain types: accounts, headers, transactions, receipts, blocks.
+
+Parity: khipu-eth/src/main/scala/khipu/domain/ (Account.scala,
+BlockHeader.scala, Transaction.scala, SignedTransaction.scala,
+Receipt.scala, TxLogEntry.scala, Block.scala, Address.scala). All hash
+identities (header hash = kec256(rlp), tx hash, sender recovery) live
+here; consensus execution consumes these via khipu_tpu.ledger.
+"""
+
+from khipu_tpu.domain.account import Account, EMPTY_CODE_HASH, EMPTY_STORAGE_ROOT
+from khipu_tpu.domain.block import Block, BlockBody
+from khipu_tpu.domain.block_header import BlockHeader
+from khipu_tpu.domain.receipt import Receipt, TxLogEntry
+from khipu_tpu.domain.transaction import SignedTransaction, Transaction
+
+__all__ = [
+    "Account",
+    "Block",
+    "BlockBody",
+    "BlockHeader",
+    "EMPTY_CODE_HASH",
+    "EMPTY_STORAGE_ROOT",
+    "Receipt",
+    "SignedTransaction",
+    "Transaction",
+    "TxLogEntry",
+]

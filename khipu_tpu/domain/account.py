@@ -1,0 +1,74 @@
+"""Account state record (domain/Account.scala:12, RLP serializer :55).
+
+An account is (nonce, balance, stateRoot, codeHash); the state trie maps
+kec256(address) -> rlp(account). stateRoot is the root of the account's
+own storage trie; codeHash keys the EVM bytecode in the evmcode store.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.base.rlp import rlp_decode, rlp_encode
+from khipu_tpu.evm.dataword import from_bytes, to_minimal_bytes
+from khipu_tpu.trie.mpt import EMPTY_TRIE_HASH
+
+EMPTY_STORAGE_ROOT: bytes = EMPTY_TRIE_HASH
+# keccak256(b"")
+EMPTY_CODE_HASH: bytes = bytes.fromhex(
+    "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+)
+
+
+@dataclass(frozen=True)
+class Account:
+    nonce: int = 0
+    balance: int = 0
+    storage_root: bytes = EMPTY_STORAGE_ROOT
+    code_hash: bytes = EMPTY_CODE_HASH
+
+    def encode(self) -> bytes:
+        return rlp_encode(
+            [
+                to_minimal_bytes(self.nonce),
+                to_minimal_bytes(self.balance),
+                self.storage_root,
+                self.code_hash,
+            ]
+        )
+
+    @staticmethod
+    def decode(data: bytes) -> "Account":
+        nonce, balance, root, code_hash = rlp_decode(data)
+        return Account(from_bytes(nonce), from_bytes(balance), root, code_hash)
+
+    def with_nonce(self, nonce: int) -> "Account":
+        return replace(self, nonce=nonce)
+
+    def increase_nonce(self, by: int = 1) -> "Account":
+        return replace(self, nonce=self.nonce + by)
+
+    def increase_balance(self, by: int) -> "Account":
+        return replace(self, balance=self.balance + by)
+
+    @property
+    def is_empty(self) -> bool:
+        """EIP-161 empty: no code, zero nonce, zero balance. Empty
+        accounts touched during execution are deleted post-tx
+        (Account.scala isEmpty semantics; note storage_root is NOT part
+        of the emptiness test)."""
+        return (
+            self.nonce == 0
+            and self.balance == 0
+            and self.code_hash == EMPTY_CODE_HASH
+        )
+
+    @property
+    def has_code(self) -> bool:
+        return self.code_hash != EMPTY_CODE_HASH
+
+
+def address_key(address: bytes) -> bytes:
+    """State-trie key for an address (Address.scala hashed-key encoder)."""
+    return keccak256(address)

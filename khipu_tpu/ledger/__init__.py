@@ -1,0 +1,34 @@
+"""Ledger: world state, block execution, parallel merge, receipts.
+
+Parity: khipu-eth/src/main/scala/khipu/ledger/ (Ledger.scala,
+BlockWorldState.scala, TrieAccounts/TrieStorage, BloomFilter.scala,
+BlockRewardCalculator.scala).
+"""
+
+from khipu_tpu.ledger.bloom import bloom_of_logs, bloom_union
+from khipu_tpu.ledger.ledger import (
+    BlockExecutionError,
+    BlockResult,
+    Stats,
+    TxResult,
+    TxValidationError,
+    ValidationAfterExecError,
+    execute_block,
+    execute_transaction,
+)
+from khipu_tpu.ledger.world import BlockWorldState, TrieStorage
+
+__all__ = [
+    "BlockExecutionError",
+    "BlockResult",
+    "BlockWorldState",
+    "Stats",
+    "TrieStorage",
+    "TxResult",
+    "TxValidationError",
+    "ValidationAfterExecError",
+    "bloom_of_logs",
+    "bloom_union",
+    "execute_block",
+    "execute_transaction",
+]

@@ -1,0 +1,478 @@
+"""Block executor: tx validation, execution, parallel merge, receipts,
+rewards, and the post-execution bit-exactness gate.
+
+Parity: ledger/Ledger.scala:95 —
+  executeBlock:230            -> execute_block (parallel attempt,
+                                 sequential fallback :250-271)
+  executeTransactions_inparallel:337 -> _execute_parallel (fresh world
+                                 per tx from the parent root :354,
+                                 serial merge + re-execute :393-434)
+  validateAndExecuteTransaction:517 -> _validate_stx + execute_transaction
+  prepareProgramContext:660   -> inside execute_transaction
+  runVM:710                   -> khipu_tpu.evm.vm
+  postExecuteTransactions:463 -> _tx_post (receipts w/ cumulative gas +
+                                 bloom, miner fee pay, EIP-161 dead-
+                                 account deletion) — folded into the
+                                 per-tx loop because sequential
+                                 semantics pays the fee of tx i before
+                                 tx i+1 runs, and pre-Byzantium receipts
+                                 carry the intermediate state root
+  payBlockReward:629          -> _pay_rewards
+  validateBlockAfterExecution:603-620 -> the gasUsed/stateRoot/
+                                 receiptsRoot/bloom gate
+
+The miner fee is paid serially in the merge loop (never inside a
+parallel tx world): txs that *read* the coinbase conflict and re-run
+serially, every other pair of txs merges commutatively.
+
+Parallelism note: worker threads give the merge algebra real
+concurrency but CPython's GIL serializes the interpreter itself; CPU
+parallelism for the Python EVM arrives with free-threaded builds or the
+native EVM (the algebra and its tests are identical either way).
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Set, Tuple, Union
+
+from khipu_tpu.base.crypto.secp256k1 import HALF_N
+from khipu_tpu.config import KhipuConfig
+from khipu_tpu.domain.block import Block
+from khipu_tpu.domain.receipt import Receipt, TxLogEntry
+from khipu_tpu.domain.transaction import SignedTransaction, contract_address
+from khipu_tpu.evm.config import EvmConfig, for_block
+from khipu_tpu.evm.vm import (
+    BlockEnv,
+    MessageEnv,
+    _execute_message,
+    create_contract,
+)
+from khipu_tpu.ledger.bloom import bloom_of_logs, bloom_union
+from khipu_tpu.ledger.rewards import block_rewards
+from khipu_tpu.ledger.world import BlockWorldState
+
+
+class BlockExecutionError(Exception):
+    """BlockExecutionError ADT (Ledger.scala:62-71)."""
+
+
+class TxValidationError(BlockExecutionError):
+    def __init__(self, index: int, reason: str):
+        super().__init__(f"tx[{index}]: {reason}")
+        self.index = index
+        self.reason = reason
+
+
+class ValidationAfterExecError(BlockExecutionError):
+    pass
+
+
+@dataclass
+class TxResult:
+    world: BlockWorldState
+    gas_used: int
+    fee: int
+    logs: List[TxLogEntry]
+    status: int  # 1 success, 0 failed (EIP-658)
+    error: Optional[str] = None  # VM-level error (tx still valid)
+
+
+@dataclass
+class Stats:
+    """Per-block perf stats (Ledger.Stats, Ledger.scala:56-58)."""
+
+    tx_count: int = 0
+    parallel_count: int = 0
+    conflict_count: int = 0
+    gas_used: int = 0
+    exec_seconds: float = 0.0
+
+    @property
+    def parallel_rate(self) -> float:
+        return self.parallel_count / self.tx_count if self.tx_count else 1.0
+
+
+@dataclass
+class BlockResult:
+    world: BlockWorldState
+    receipts: List[Receipt]
+    gas_used: int
+    stats: Stats
+
+
+# ------------------------------------------------------------ validation
+
+
+def _validate_stx(
+    stx: SignedTransaction,
+    sender: Optional[bytes],
+    config: EvmConfig,
+    world: BlockWorldState,
+    accumulated_gas: int,
+    block_gas_limit: int,
+    index: int,
+) -> None:
+    """SignedTransactionValidator semantics (sig/nonce/gas/balance)."""
+    tx = stx.tx
+    if sender is None:
+        raise TxValidationError(index, "unrecoverable signature")
+    if config.homestead and stx.s > HALF_N:
+        raise TxValidationError(index, "high s (EIP-2)")
+    cid = stx.chain_id
+    if cid is not None:
+        if not config.eip155:
+            raise TxValidationError(index, "EIP-155 v before fork")
+        if cid != config.chain_id:
+            raise TxValidationError(index, f"wrong chain id {cid}")
+    nonce = world.get_nonce(sender)
+    if tx.nonce != nonce:
+        raise TxValidationError(
+            index, f"nonce {tx.nonce} != account {nonce}"
+        )
+    intrinsic = config.intrinsic_gas(tx.payload, tx.is_contract_creation)
+    if tx.gas_limit < intrinsic:
+        raise TxValidationError(
+            index, f"gas limit {tx.gas_limit} < intrinsic {intrinsic}"
+        )
+    upfront = tx.gas_limit * tx.gas_price + tx.value
+    balance = world.get_balance(sender)
+    if balance < upfront:
+        raise TxValidationError(
+            index, f"balance {balance} < upfront {upfront}"
+        )
+    if accumulated_gas + tx.gas_limit > block_gas_limit:
+        raise TxValidationError(index, "cumulative gas above block limit")
+
+
+# ------------------------------------------------------------- execution
+
+
+def execute_transaction(
+    config: EvmConfig,
+    world: BlockWorldState,
+    block_env: BlockEnv,
+    stx: SignedTransaction,
+    sender: bytes,
+) -> TxResult:
+    """One validated tx against ``world`` (mutates it). Miner fee is
+    returned, not paid (see module docstring)."""
+    tx = stx.tx
+    gas_price = tx.gas_price
+    gas_limit = tx.gas_limit
+
+    world.increase_nonce(sender)
+    world.add_balance(sender, -(gas_limit * gas_price))  # gas escrow
+    intrinsic = config.intrinsic_gas(tx.payload, tx.is_contract_creation)
+    gas = gas_limit - intrinsic
+
+    checkpoint = world.copy()
+    if tx.is_contract_creation:
+        new_addr = contract_address(sender, tx.nonce)
+        result, _ = create_contract(
+            config, world, block_env, sender, sender, new_addr, gas,
+            gas_price, tx.value, tx.payload, depth=0,
+        )
+    else:
+        child = world.copy()
+        child.transfer(sender, tx.to, tx.value)
+        child.touch(tx.to)
+        env = MessageEnv(
+            owner=tx.to,
+            caller=sender,
+            origin=sender,
+            gas_price=gas_price,
+            value=tx.value,
+            input_data=tx.payload,
+            depth=0,
+        )
+        result = _execute_message(
+            config, child, block_env, env, world.get_code(tx.to), gas, tx.to
+        )
+
+    if result.error is not None:
+        world = checkpoint
+        gas_remaining = 0
+        logs: List[TxLogEntry] = []
+        status = 0
+        err: Optional[str] = result.error
+    elif result.is_revert:
+        world = checkpoint
+        gas_remaining = result.gas_remaining
+        logs = []
+        status = 0
+        err = "Revert"
+    else:
+        world = result.world
+        gas_used_pre = gas_limit - result.gas_remaining
+        refund = min(max(result.refund, 0), gas_used_pre // 2)
+        gas_remaining = result.gas_remaining + refund
+        for addr in sorted(world.selfdestructed):
+            world.delete_account(addr)
+        world.selfdestructed.clear()
+        logs = list(result.logs)
+        status = 1
+        err = None
+
+    world.add_balance(sender, gas_remaining * gas_price)
+
+    # EIP-161: touched accounts that end the tx dead are deleted.
+    # get_account (not _current_account) so the emptiness observation is
+    # a RECORDED read: if an earlier parallel tx credited the account,
+    # the merge must flag a conflict instead of letting this deletion
+    # erase the credit.
+    if config.eip161:
+        for addr in sorted(world.touched):
+            acc = world.get_account(addr)
+            if acc is not None and acc.is_empty:
+                world.delete_account(addr)
+    world.touched.clear()
+
+    gas_used = gas_limit - gas_remaining
+    return TxResult(world, gas_used, gas_used * gas_price, logs, status, err)
+
+
+def _tx_post(
+    config: EvmConfig,
+    world: BlockWorldState,
+    r: TxResult,
+    beneficiary: bytes,
+    cumulative: int,
+    receipts: List[Receipt],
+) -> int:
+    """Pay the miner fee of one tx and build its receipt — the serial
+    per-tx tail of postExecuteTransactions:463."""
+    world.add_balance(beneficiary, r.fee)
+    world.touch(beneficiary)
+    if config.eip161:
+        acc = world.get_account(beneficiary)
+        if acc is not None and acc.is_empty:
+            world.delete_account(beneficiary)
+    world.touched.discard(beneficiary)
+    cumulative += r.gas_used
+    bloom = bloom_of_logs(r.logs)
+    if config.byzantium:
+        post: Union[bytes, int] = r.status
+    else:
+        post = world.root_hash  # intermediate root, sequential-exact
+    receipts.append(Receipt(post, cumulative, bloom, tuple(r.logs)))
+    return cumulative
+
+
+def execute_block(
+    block: Block,
+    parent_state_root: bytes,
+    make_world: Callable[[bytes], BlockWorldState],
+    khipu_config: KhipuConfig,
+    validate: bool = True,
+) -> BlockResult:
+    """Execute every tx of a block and gate the result against the
+    header (executeBlock:230 + validateBlockAfterExecution:603-620).
+
+    ``make_world(state_root)`` builds a fresh world at a root — the
+    Blockchain facade provides it. Raises BlockExecutionError.
+    """
+    header = block.header
+    config = for_block(header.number, khipu_config.blockchain)
+    block_env = BlockEnv(
+        number=header.number,
+        timestamp=header.unix_timestamp,
+        difficulty=header.difficulty,
+        gas_limit=header.gas_limit,
+        beneficiary=header.beneficiary,
+        get_block_hash=lambda n: None,
+    )
+    # BLOCKHASH resolution comes from the world factory's chain access
+    probe = make_world(parent_state_root)
+    block_env.get_block_hash = probe.get_block_hash
+    txs = list(block.body.transactions)
+    senders = [stx.sender for stx in txs]
+    t0 = time.perf_counter()
+    stats = Stats(tx_count=len(txs))
+
+    if khipu_config.sync.parallel_tx and len(txs) > 1:
+        world, receipts, gas_used = _execute_parallel(
+            config, block_env, txs, senders, parent_state_root,
+            make_world, header, khipu_config.sync.tx_workers, stats,
+        )
+    else:
+        world, receipts, gas_used = _execute_sequential(
+            config, block_env, txs, senders, parent_state_root,
+            make_world, header,
+        )
+
+    _pay_rewards(world, block, khipu_config)
+    stats.gas_used = gas_used
+    stats.exec_seconds = time.perf_counter() - t0
+
+    if validate:
+        _validate_after(block, world, receipts, gas_used)
+    return BlockResult(world, receipts, gas_used, stats)
+
+
+def _execute_sequential(
+    config, block_env, txs, senders, parent_root, make_world, header,
+):
+    """Serial fold (the :250-271 fallback path)."""
+    world = make_world(parent_root)
+    receipts: List[Receipt] = []
+    cumulative = 0
+    accumulated_gas = 0
+    for i in range(len(txs)):
+        _validate_stx(
+            txs[i], senders[i], config, world, accumulated_gas,
+            header.gas_limit, i,
+        )
+        r = execute_transaction(config, world, block_env, txs[i], senders[i])
+        world = r.world  # call frames fork copies; adopt the final one
+        accumulated_gas += r.gas_used
+        cumulative = _tx_post(
+            config, world, r, header.beneficiary, cumulative, receipts
+        )
+    return world, receipts, cumulative
+
+
+def _run_one(
+    config: EvmConfig,
+    make_world: Callable[[], BlockWorldState],
+    block_env: BlockEnv,
+    stx: SignedTransaction,
+    sender: Optional[bytes],
+    index: int,
+    block_gas_limit: int,
+) -> Union[TxResult, TxValidationError]:
+    """Parallel work unit: fresh world from the parent root
+    (Ledger.scala:354), validate against the parent snapshot (the merge
+    decides whether that was legitimate), execute."""
+    world = make_world()
+    try:
+        _validate_stx(stx, sender, config, world, 0, block_gas_limit, index)
+    except TxValidationError as e:
+        e.world = world  # type: ignore[attr-defined]
+        return e
+    return execute_transaction(config, world, block_env, stx, sender)
+
+
+def _execute_parallel(
+    config, block_env, txs, senders, parent_root, make_world, header,
+    workers, stats: Stats,
+):
+    """Optimistic parallel execution + serial merge (P1,
+    Ledger.scala:337-461)."""
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        futures = [
+            pool.submit(
+                _run_one, config, lambda: make_world(parent_root),
+                block_env, txs[i], senders[i], i, header.gas_limit,
+            )
+            for i in range(len(txs))
+        ]
+        outcomes = [f.result() for f in futures]
+
+    merged = make_world(parent_root)
+    receipts: List[Receipt] = []
+    cumulative = 0
+    accumulated_gas = 0
+
+    def re_execute(i: int) -> TxResult:
+        stats.conflict_count += 1
+        _validate_stx(
+            txs[i], senders[i], config, merged, accumulated_gas,
+            header.gas_limit, i,
+        )
+        return execute_transaction(
+            config, merged, block_env, txs[i], senders[i]
+        )
+
+    for i, out in enumerate(outcomes):
+        if isinstance(out, TxValidationError):
+            if _reads_conflict(merged, out.world) is None:
+                raise out  # invalid against true sequential state too
+            r = re_execute(i)  # stale snapshot — retry on merged world
+            merged = r.world
+        else:
+            # the parallel pass validated with accumulated_gas=0 — the
+            # cumulative block-gas rule (YP eq. 58) must be re-checked
+            # against the true running total before accepting the merge
+            if accumulated_gas + txs[i].tx.gas_limit > header.gas_limit:
+                raise TxValidationError(
+                    i, "cumulative gas above block limit"
+                )
+            conflict = merged.merge(out.world)
+            if conflict is None:
+                stats.parallel_count += 1
+                r = out
+            else:
+                r = re_execute(i)
+                merged = r.world
+        accumulated_gas += r.gas_used
+        cumulative = _tx_post(
+            config, merged, r, header.beneficiary, cumulative, receipts
+        )
+    return merged, receipts, cumulative
+
+
+def _reads_conflict(merged: BlockWorldState, tx_world) -> Optional[Set]:
+    """Did tx_world read anything merged has written? None = no."""
+    conflicts: Set = set()
+    for cat in tx_world.reads:
+        conflicts |= tx_world.reads[cat] & merged.written[cat]
+    return conflicts or None
+
+
+def _pay_rewards(world: BlockWorldState, block: Block, khipu_config) -> None:
+    """payBlockReward (Ledger.scala:629) + EIP-161 touch semantics."""
+    bc = khipu_config.blockchain
+    header = block.header
+    miner_reward, ommer_rewards = block_rewards(
+        header.number, [o.number for o in block.body.ommers], bc
+    )
+    world.add_balance(header.beneficiary, miner_reward)
+    world.touch(header.beneficiary)
+    config = for_block(header.number, bc)
+    for ommer, reward in zip(block.body.ommers, ommer_rewards):
+        if reward:
+            world.add_balance(ommer.beneficiary, reward)
+            world.touch(ommer.beneficiary)
+    if config.eip161:
+        for addr in [header.beneficiary] + [
+            o.beneficiary for o in block.body.ommers
+        ]:
+            acc = world.get_account(addr)
+            if acc is not None and acc.is_empty:
+                world.delete_account(addr)
+    world.touched.clear()
+
+
+def _validate_after(
+    block: Block, world: BlockWorldState, receipts: List[Receipt],
+    gas_used: int,
+) -> None:
+    """The bit-exactness gate (Ledger.scala:603-620)."""
+    from khipu_tpu.validators.roots import receipts_root
+
+    header = block.header
+    if gas_used != header.gas_used:
+        raise ValidationAfterExecError(
+            f"block {header.number}: gasUsed {gas_used} != header "
+            f"{header.gas_used}"
+        )
+    root = world.root_hash
+    if root != header.state_root:
+        raise ValidationAfterExecError(
+            f"block {header.number}: stateRoot {root.hex()} != header "
+            f"{header.state_root.hex()}"
+        )
+    rroot = receipts_root(receipts)
+    if rroot != header.receipts_root:
+        raise ValidationAfterExecError(
+            f"block {header.number}: receiptsRoot {rroot.hex()} != "
+            f"header {header.receipts_root.hex()}"
+        )
+    bloom = bloom_union(r.logs_bloom for r in receipts)
+    if bloom != header.logs_bloom:
+        raise ValidationAfterExecError(
+            f"block {header.number}: logsBloom mismatch"
+        )

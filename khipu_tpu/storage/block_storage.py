@@ -102,14 +102,13 @@ class BlockNumbers:
         self._lock = threading.RLock()
 
     def number_of(self, block_hash: bytes) -> Optional[int]:
+        # One critical section: the storage read and the map insert must
+        # not interleave with remove(), or a reorg-orphaned mapping
+        # would be resurrected.
         with self._lock:
             n = self._hash_to_num.get(block_hash)
-        if n is not None:
-            return n
-        # Re-check the storage under the lock before caching, as in
-        # hash_of: a remove() between an unlocked read and the insert
-        # would resurrect a reorg-orphaned mapping.
-        with self._lock:
+            if n is not None:
+                return n
             n = self._storage.get(block_hash)
             if n is not None:
                 self._hash_to_num[block_hash] = n

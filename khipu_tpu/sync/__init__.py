@@ -1,0 +1,7 @@
+"""Sync drivers: chain building + regular-sync replay
+(blockchain/sync/RegularSyncService.scala role, networking-free)."""
+
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.sync.replay import ReplayDriver
+
+__all__ = ["ChainBuilder", "ReplayDriver"]

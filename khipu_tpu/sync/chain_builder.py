@@ -1,0 +1,98 @@
+"""Fixture-chain construction: execute txs to derive consensus-true
+headers, producing a chain the replay driver can verify bit-exactly.
+
+Role of the reference's mining/BlockGenerator.scala:31 (prepareBlock —
+execute the txs, take the resulting roots/gas into the new header),
+minus PoW sealing. Used by tests and the replay benchmark to provision
+chains offline (no network in this environment).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+from khipu_tpu.config import KhipuConfig
+from khipu_tpu.domain.block import Block, BlockBody
+from khipu_tpu.domain.block_header import EMPTY_OMMERS_HASH, BlockHeader
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import SignedTransaction
+from khipu_tpu.ledger.bloom import bloom_union
+from khipu_tpu.ledger.ledger import execute_block
+from khipu_tpu.validators.roots import receipts_root, transactions_root
+
+
+class ChainBuilder:
+    """Appends consensus-valid blocks by executing their transactions
+    (BlockGenerator/prepareBlock role)."""
+
+    def __init__(self, blockchain: Blockchain, config: KhipuConfig,
+                 genesis: GenesisSpec):
+        self.blockchain = blockchain
+        self.config = config
+        self.genesis = blockchain.load_genesis(genesis)
+        self.head = self.genesis
+
+    def add_block(
+        self,
+        txs: Sequence[SignedTransaction] = (),
+        coinbase: Optional[bytes] = None,
+        timestamp: Optional[int] = None,
+        extra_data: bytes = b"",
+    ) -> Block:
+        parent = self.head.header
+        header = BlockHeader(
+            parent_hash=parent.hash,
+            ommers_hash=EMPTY_OMMERS_HASH,
+            beneficiary=coinbase or parent.beneficiary,
+            state_root=b"\x00" * 32,  # filled after execution
+            transactions_root=transactions_root(txs),
+            receipts_root=b"\x00" * 32,
+            logs_bloom=b"\x00" * 256,
+            difficulty=parent.difficulty,
+            number=parent.number + 1,
+            gas_limit=parent.gas_limit,
+            gas_used=0,
+            unix_timestamp=(
+                timestamp
+                if timestamp is not None
+                else parent.unix_timestamp + 13
+            ),
+            extra_data=extra_data,
+        )
+        draft = Block(header, BlockBody(tuple(txs)))
+        result = execute_block(
+            draft,
+            parent.state_root,
+            self.blockchain.get_world_state,
+            self.config,
+            validate=False,
+        )
+        sealed = Block(
+            BlockHeader(
+                parent_hash=header.parent_hash,
+                ommers_hash=header.ommers_hash,
+                beneficiary=header.beneficiary,
+                state_root=result.world.root_hash,
+                transactions_root=header.transactions_root,
+                receipts_root=receipts_root(result.receipts),
+                logs_bloom=bloom_union(
+                    r.logs_bloom for r in result.receipts
+                ),
+                difficulty=header.difficulty,
+                number=header.number,
+                gas_limit=header.gas_limit,
+                gas_used=result.gas_used,
+                unix_timestamp=header.unix_timestamp,
+                extra_data=header.extra_data,
+            ),
+            draft.body,
+        )
+        td = (self.blockchain.get_total_difficulty(parent.number) or 0) + (
+            sealed.header.difficulty
+        )
+        self.blockchain.save_block(
+            sealed, result.receipts, td, result.world
+        )
+        self.head = sealed
+        return sealed

@@ -1,0 +1,23 @@
+"""Consensus validators (khipu-eth/.../validators/)."""
+
+from khipu_tpu.validators.roots import (
+    ommers_hash,
+    receipts_root,
+    transactions_root,
+)
+from khipu_tpu.validators.validators import (
+    BlockValidator,
+    HeaderValidationError,
+    BlockHeaderValidator,
+    ValidationError,
+)
+
+__all__ = [
+    "BlockHeaderValidator",
+    "BlockValidator",
+    "HeaderValidationError",
+    "ValidationError",
+    "ommers_hash",
+    "receipts_root",
+    "transactions_root",
+]

@@ -1,0 +1,109 @@
+"""Header/block structural validators.
+
+Parity: validators/BlockHeaderValidator.scala:36 (difficulty, gas
+limit/used, timestamp, number, extra-data :54-197 — PoW seal check is
+pluggable and off by default, matching how fixture/replay chains are
+driven) and BlockValidator.scala:19 (tx root :82, ommers hash :102,
+receipts root :121, log bloom :142).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from khipu_tpu.config import BlockchainConfig
+from khipu_tpu.domain.block import Block
+from khipu_tpu.domain.block_header import BlockHeader
+from khipu_tpu.domain.receipt import Receipt
+from khipu_tpu.ledger.bloom import bloom_union
+from khipu_tpu.validators.roots import (
+    ommers_hash,
+    receipts_root,
+    transactions_root,
+)
+
+MAX_EXTRA_DATA = 32
+GAS_LIMIT_BOUND_DIVISOR = 1024
+MIN_GAS_LIMIT = 5000
+
+
+class ValidationError(Exception):
+    pass
+
+
+class HeaderValidationError(ValidationError):
+    pass
+
+
+class BlockHeaderValidator:
+    """Structural + parent-linked header checks. ``seal_check`` is a
+    hook for a PoW validator (consensus/pow) — None skips seal
+    validation (fixture chains, fast sync headers-only mode)."""
+
+    def __init__(
+        self,
+        bc: BlockchainConfig,
+        difficulty_fn: Optional[Callable[[BlockHeader, BlockHeader], int]] = None,
+        seal_check: Optional[Callable[[BlockHeader], bool]] = None,
+    ):
+        self.bc = bc
+        self.difficulty_fn = difficulty_fn
+        self.seal_check = seal_check
+
+    def validate(self, header: BlockHeader, parent: BlockHeader) -> None:
+        if header.number != parent.number + 1:
+            raise HeaderValidationError(
+                f"number {header.number} != parent+1 ({parent.number + 1})"
+            )
+        if header.parent_hash != parent.hash:
+            raise HeaderValidationError("parent hash mismatch")
+        if len(header.extra_data) > MAX_EXTRA_DATA:
+            raise HeaderValidationError("extra data > 32 bytes")
+        if header.unix_timestamp <= parent.unix_timestamp:
+            raise HeaderValidationError("timestamp not after parent")
+        if header.gas_used > header.gas_limit:
+            raise HeaderValidationError("gasUsed > gasLimit")
+        limit_delta = abs(header.gas_limit - parent.gas_limit)
+        if limit_delta >= parent.gas_limit // GAS_LIMIT_BOUND_DIVISOR:
+            raise HeaderValidationError("gas limit delta out of bounds")
+        if header.gas_limit < MIN_GAS_LIMIT:
+            raise HeaderValidationError("gas limit below minimum")
+        if self.difficulty_fn is not None:
+            expected = self.difficulty_fn(header, parent)
+            if header.difficulty != expected:
+                raise HeaderValidationError(
+                    f"difficulty {header.difficulty} != calculated {expected}"
+                )
+        if self.seal_check is not None and not self.seal_check(header):
+            raise HeaderValidationError("invalid PoW seal")
+
+
+class BlockValidator:
+    """Body-vs-header consistency (BlockValidator.scala:19)."""
+
+    @staticmethod
+    def validate_body(block: Block) -> None:
+        header = block.header
+        troot = transactions_root(block.body.transactions)
+        if troot != header.transactions_root:
+            raise ValidationError(
+                f"tx root {troot.hex()} != header "
+                f"{header.transactions_root.hex()}"
+            )
+        ohash = ommers_hash(block.body.ommers)
+        if ohash != header.ommers_hash:
+            raise ValidationError("ommers hash mismatch")
+
+    @staticmethod
+    def validate_receipts(
+        header: BlockHeader, receipts: Sequence[Receipt]
+    ) -> None:
+        rroot = receipts_root(receipts)
+        if rroot != header.receipts_root:
+            raise ValidationError(
+                f"receipts root {rroot.hex()} != header "
+                f"{header.receipts_root.hex()}"
+            )
+        bloom = bloom_union(r.logs_bloom for r in receipts)
+        if bloom != header.logs_bloom:
+            raise ValidationError("log bloom mismatch")

@@ -1,0 +1,163 @@
+"""Domain-type tests: hash identities against published Ethereum
+vectors (SURVEY.md §4 plan item 1; parity targets domain/*.scala)."""
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.domain.account import (
+    EMPTY_CODE_HASH,
+    EMPTY_STORAGE_ROOT,
+    Account,
+)
+from khipu_tpu.domain.block import Block, BlockBody
+from khipu_tpu.domain.block_header import EMPTY_OMMERS_HASH, BlockHeader
+from khipu_tpu.domain.receipt import (
+    Receipt,
+    TxLogEntry,
+    decode_receipts,
+    encode_receipts,
+)
+from khipu_tpu.domain.transaction import (
+    SignedTransaction,
+    Transaction,
+    contract_address,
+    create2_address,
+    sign_transaction,
+)
+from khipu_tpu.trie.mpt import EMPTY_TRIE_HASH
+
+# The published mainnet genesis state root (tests/test_trie.py builds it
+# from the alloc fixture) and block hash.
+MAINNET_GENESIS_STATE_ROOT = bytes.fromhex(
+    "d7f8974fb5ac78d9ac099b9ad5018bedc2ce0a72dad1827a1709da30580f0544"
+)
+MAINNET_GENESIS_HASH = bytes.fromhex(
+    "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3"
+)
+
+
+class TestBlockHeader:
+    def mainnet_genesis_header(self):
+        return BlockHeader(
+            parent_hash=b"\x00" * 32,
+            ommers_hash=EMPTY_OMMERS_HASH,
+            beneficiary=b"\x00" * 20,
+            state_root=MAINNET_GENESIS_STATE_ROOT,
+            transactions_root=EMPTY_TRIE_HASH,
+            receipts_root=EMPTY_TRIE_HASH,
+            logs_bloom=b"\x00" * 256,
+            difficulty=0x400000000,
+            number=0,
+            gas_limit=0x1388,
+            gas_used=0,
+            unix_timestamp=0,
+            extra_data=bytes.fromhex(
+                "11bbe8db4e347b4e8c937c1c8370e4b5"
+                "ed33adb3db69cbdb7a38e1e50b1b82fa"
+            ),
+            mix_hash=b"\x00" * 32,
+            nonce=bytes.fromhex("0000000000000042"),
+        )
+
+    def test_mainnet_genesis_hash(self):
+        """hash = kec256(rlp(header)) reproduces the published mainnet
+        genesis block hash — the full 15-field RLP identity."""
+        assert self.mainnet_genesis_header().hash == MAINNET_GENESIS_HASH
+
+    def test_decode_roundtrip(self):
+        h = self.mainnet_genesis_header()
+        assert BlockHeader.decode(h.encode()) == h
+
+
+class TestTransaction:
+    def test_eip155_sender_recovery(self):
+        """The EIP-155 example: priv 0x46..46 -> published sender."""
+        tx = Transaction(
+            nonce=9,
+            gas_price=20 * 10**9,
+            gas_limit=21000,
+            to=bytes.fromhex("3535353535353535353535353535353535353535"),
+            value=10**18,
+        )
+        stx = sign_transaction(tx, b"\x46" * 32, chain_id=1)
+        assert stx.v == 37
+        assert stx.sender == pubkey_to_address(
+            privkey_to_pubkey(b"\x46" * 32)
+        )
+        assert stx.chain_id == 1
+
+    def test_decode_roundtrip_and_hash_stability(self):
+        tx = Transaction(3, 10**9, 50_000, None, 7, b"\x60\x00")
+        stx = sign_transaction(tx, b"\x01".rjust(32, b"\x00"), chain_id=5)
+        again = SignedTransaction.decode(stx.encode())
+        assert again == stx
+        assert again.hash == stx.hash
+        assert again.sender == stx.sender
+
+    def test_pre_eip155_signature(self):
+        tx = Transaction(0, 1, 21000, b"\x11" * 20, 5)
+        stx = sign_transaction(tx, b"\x02".rjust(32, b"\x00"))
+        assert stx.v in (27, 28)
+        assert stx.chain_id is None
+        assert stx.sender == pubkey_to_address(
+            privkey_to_pubkey(b"\x02".rjust(32, b"\x00"))
+        )
+
+    def test_tampered_signature_changes_sender(self):
+        tx = Transaction(0, 1, 21000, b"\x11" * 20, 5)
+        stx = sign_transaction(tx, b"\x02".rjust(32, b"\x00"))
+        bad = SignedTransaction(tx, stx.v, stx.r, stx.s ^ 1)
+        assert bad.sender != stx.sender
+
+    def test_contract_addresses(self):
+        sender = bytes.fromhex("6ac7ea33f8831ea9dcc53393aaa88b25a785dbf0")
+        # cow's first contract address (well-known vector)
+        assert contract_address(sender, 0) == bytes.fromhex(
+            "cd234a471b72ba2f1ccf0a70fcaba648a5eecd8d"
+        )
+        # EIP-1014 example 1: sender 0x0, salt 0, code 0x00
+        assert create2_address(
+            b"\x00" * 20, b"\x00" * 32, b"\x00"
+        ) == bytes.fromhex("4d1a2e2bb4f88f0250f26ffff098b0b30b26bf38")
+
+
+class TestAccountAndReceipts:
+    def test_fresh_account_encoding(self):
+        acc = Account()
+        assert acc.storage_root == EMPTY_STORAGE_ROOT
+        assert acc.code_hash == EMPTY_CODE_HASH
+        assert Account.decode(acc.encode()) == acc
+        assert acc.is_empty
+
+    def test_account_roundtrip(self):
+        acc = Account(5, 10**20, b"\x11" * 32, b"\x22" * 32)
+        assert Account.decode(acc.encode()) == acc
+        assert not acc.is_empty
+
+    def test_receipt_roundtrip_status_and_root(self):
+        log = TxLogEntry(b"\xaa" * 20, (b"\x01" * 32, b"\x02" * 32), b"xy")
+        for post in (1, 0, b"\x33" * 32):
+            r = Receipt(post, 21_000, b"\x00" * 256, (log,))
+            assert Receipt.decode(r.encode()) == r
+
+    def test_receipts_list_codec(self):
+        rs = [
+            Receipt(1, 21000, b"\x00" * 256),
+            Receipt(0, 42000, b"\x00" * 256),
+        ]
+        assert decode_receipts(encode_receipts(rs)) == rs
+
+
+class TestBlock:
+    def test_block_codec_roundtrip(self):
+        tx = sign_transaction(
+            Transaction(0, 1, 21000, b"\x11" * 20, 5),
+            b"\x03".rjust(32, b"\x00"),
+            chain_id=1,
+        )
+        header = TestBlockHeader().mainnet_genesis_header()
+        block = Block(header, BlockBody((tx,), (header,)))
+        assert Block.decode(block.encode()) == block
+        body = BlockBody((tx,), ())
+        assert BlockBody.decode(body.encode()) == body

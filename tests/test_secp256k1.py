@@ -36,8 +36,7 @@ EIP155_V = 37  # chain_id 1, parity 0 -> 35 + 0
 
 
 def eip155_signing_payload():
-    def i2b(n):
-        return n.to_bytes((n.bit_length() + 7) // 8, "big") if n else b""
+    from khipu_tpu.base.bytes_util import int_to_big_endian as i2b
 
     return rlp_encode(
         [
