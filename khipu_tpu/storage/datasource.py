@@ -73,6 +73,9 @@ class BlockDataSource(DataSource):
     def put(self, number: int, value: bytes) -> None:
         self.update([], {number: value})
 
+    def remove(self, number: int) -> None:
+        self.update([number], {})
+
     def update(
         self, to_remove: Iterable[int], to_upsert: Mapping[int, bytes]
     ) -> None:

@@ -33,44 +33,42 @@ class Storages:
                  unconfirmed_depth: int = 20, cache_size: int = 1 << 20):
         self.engine = engine
         if engine == "memory":
-            account_src = MemoryNodeDataSource()
-            storage_src = MemoryNodeDataSource()
-            evmcode_src = MemoryNodeDataSource()
+            node_src = lambda topic: MemoryNodeDataSource()
+            block_src = lambda topic: MemoryBlockDataSource()
+            kv_src = lambda topic: MemoryKeyValueDataSource()
         elif engine == "native":
             if data_dir is None:
                 raise ValueError("native engine requires data_dir")
-            try:
-                from khipu_tpu.native.store import NativeNodeDataSource
-            except ImportError as e:
-                raise NotImplementedError(
-                    "db.engine='native' requires the C++ append-log store "
-                    "(khipu_tpu/native/store.py) and a working g++"
-                ) from e
-            account_src = NativeNodeDataSource(data_dir, "account")
-            storage_src = NativeNodeDataSource(data_dir, "storage")
-            evmcode_src = NativeNodeDataSource(data_dir, "evmcode")
+            from khipu_tpu.native.store import (
+                NativeBlockDataSource,
+                NativeKeyValueDataSource,
+                NativeNodeDataSource,
+            )
+
+            node_src = lambda topic: NativeNodeDataSource(data_dir, topic)
+            block_src = lambda topic: NativeBlockDataSource(data_dir, topic)
+            kv_src = lambda topic: NativeKeyValueDataSource(data_dir, topic)
         else:
             raise ValueError(f"unknown db.engine {engine!r}")
 
+        # topic names match DbConfig.scala:11-21
         self.account_node_storage = NodeStorage(
-            account_src, unconfirmed_depth, cache_size)
+            node_src("account"), unconfirmed_depth, cache_size)
         self.storage_node_storage = NodeStorage(
-            storage_src, unconfirmed_depth, cache_size)
+            node_src("storage"), unconfirmed_depth, cache_size)
         self.evmcode_storage = NodeStorage(
-            evmcode_src, unconfirmed_depth, cache_size)
+            node_src("evmcode"), unconfirmed_depth, cache_size)
 
-        self.block_header_storage = BlockBytesStorage(MemoryBlockDataSource())
-        self.block_body_storage = BlockBytesStorage(MemoryBlockDataSource())
-        self.receipts_storage = BlockBytesStorage(MemoryBlockDataSource())
+        self.block_header_storage = BlockBytesStorage(block_src("header"))
+        self.block_body_storage = BlockBytesStorage(block_src("body"))
+        self.receipts_storage = BlockBytesStorage(block_src("receipts"))
         self.total_difficulty_storage = TotalDifficultyStorage(
-            MemoryBlockDataSource())
-        self.block_number_storage = BlockNumberStorage(
-            MemoryKeyValueDataSource())
+            block_src("td"))
+        self.block_number_storage = BlockNumberStorage(kv_src("blocknum"))
         self.block_numbers = BlockNumbers(
             self.block_number_storage, self.block_header_storage)
-        self.transaction_storage = TransactionStorage(
-            MemoryKeyValueDataSource())
-        self.app_state = AppStateStorage(MemoryKeyValueDataSource())
+        self.transaction_storage = TransactionStorage(kv_src("tx"))
+        self.app_state = AppStateStorage(kv_src("appstate"))
 
         self._node_storages = (
             self.account_node_storage,
@@ -94,13 +92,28 @@ class Storages:
         for s in self._node_storages:
             s.clear_unconfirmed()
 
+    def _all_sources(self):
+        for s in self._node_storages:
+            yield s.source
+        yield self.block_header_storage.source
+        yield self.block_body_storage.source
+        yield self.receipts_storage.source
+        yield self.total_difficulty_storage.source
+        yield self.block_number_storage.source
+        yield self.transaction_storage.source
+        yield self.app_state.source
+
     def flush(self) -> None:
         for s in self._node_storages:
             s.flush()
+        for src in self._all_sources():
+            fl = getattr(src, "flush", None)
+            if fl:
+                fl()
 
     def stop(self) -> None:
         self.flush()
-        for s in self._node_storages:
-            stop = getattr(s.source, "stop", None)
+        for src in self._all_sources():
+            stop = getattr(src, "stop", None)
             if stop:
                 stop()

@@ -1,0 +1,176 @@
+"""Native append-log engine tests: the db.engine='native' persistent
+path (csrc/store.cc; Kesque role, KesqueNodeDataSource.scala:18-230).
+
+Covers content-address verify + dedup, explicit-key updates and
+tombstones, restart survival, torn-tail crash recovery, and the full
+Storages suite over the engine.
+"""
+
+import os
+import struct
+
+import pytest
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.native.store import available
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native toolchain unavailable"
+)
+
+
+def test_node_source_roundtrip_and_dedup(tmp_path):
+    from khipu_tpu.native.store import NativeNodeDataSource
+
+    src = NativeNodeDataSource(str(tmp_path), "account")
+    values = [b"node-%d" % i * (i + 1) for i in range(50)]
+    upserts = {keccak256(v): v for v in values}
+    src.update([], upserts)
+    for k, v in upserts.items():
+        assert src.get(k) == v
+    assert src.get(b"\x00" * 32) is None
+    before = os.path.getsize(tmp_path / "account.log")
+    src.update([], upserts)  # re-put: content-addressed dedup, no growth
+    assert os.path.getsize(tmp_path / "account.log") == before
+    src.stop()
+
+
+def test_content_address_collision_guard(tmp_path):
+    """Two keys sharing the 8-byte short key must not cross-read: the
+    store recomputes keccak256(value) on every get (:61-63)."""
+    from khipu_tpu.native.store import NativeNodeDataSource
+
+    src = NativeNodeDataSource(str(tmp_path), "n")
+    v = b"some node"
+    k = keccak256(v)
+    src.put(k, v)
+    fake = b"\xde\xad" * 12 + k[-8:]  # same short key, different hash
+    assert src.get(fake) is None
+    src.stop()
+
+
+def test_kv_update_and_tombstone(tmp_path):
+    from khipu_tpu.native.store import NativeKeyValueDataSource
+
+    src = NativeKeyValueDataSource(str(tmp_path), "kv")
+    src.put(b"alpha", b"1")
+    src.put(b"alpha", b"2")  # newest record wins
+    assert src.get(b"alpha") == b"2"
+    src.remove(b"alpha")
+    assert src.get(b"alpha") is None
+    src.put(b"alpha", b"3")  # resurrect after tombstone
+    assert src.get(b"alpha") == b"3"
+    src.stop()
+
+
+def test_block_source_best_number(tmp_path):
+    from khipu_tpu.native.store import NativeBlockDataSource
+
+    src = NativeBlockDataSource(str(tmp_path), "header")
+    assert src.best_block_number == -1
+    src.update([], {0: b"h0", 1: b"h1", 2: b"h2"})
+    assert src.best_block_number == 2
+    src.update([2], {})  # reorg orphaning walks best down
+    assert src.best_block_number == 1
+    src.stop()
+    reopened = NativeBlockDataSource(str(tmp_path), "header")
+    assert reopened.get(1) == b"h1"
+    assert reopened.get(2) is None  # tombstoned
+    # reopen walks down past the tombstone to the highest live block
+    assert reopened.best_block_number == 1
+    reopened.stop()
+
+
+def test_restart_survival(tmp_path):
+    from khipu_tpu.native.store import NativeNodeDataSource
+
+    src = NativeNodeDataSource(str(tmp_path), "account")
+    upserts = {keccak256(b"x%d" % i): b"x%d" % i for i in range(100)}
+    src.update([], upserts)
+    src.stop()
+    again = NativeNodeDataSource(str(tmp_path), "account")
+    assert again.count == 100
+    for k, v in upserts.items():
+        assert again.get(k) == v
+    again.stop()
+
+
+def test_torn_tail_recovery(tmp_path):
+    """A crash mid-append leaves a torn record; reopen must truncate it
+    and keep everything before (Kafka log-recovery semantics)."""
+    from khipu_tpu.native.store import NativeNodeDataSource
+
+    src = NativeNodeDataSource(str(tmp_path), "account")
+    good = {keccak256(b"keep%d" % i): b"keep%d" % i for i in range(10)}
+    src.update([], good)
+    src.stop()
+    # simulate torn append: a length header promising more than exists
+    with open(tmp_path / "account.log", "ab") as f:
+        f.write(struct.pack("<I", 1000) + b"only-a-fragment")
+    again = NativeNodeDataSource(str(tmp_path), "account")
+    assert again.count == 10
+    for k, v in good.items():
+        assert again.get(k) == v
+    again.stop()
+
+
+def test_stale_index_rebuilt_from_log(tmp_path):
+    """Deleting the index sidecar must not lose data — the log is the
+    source of truth and the tail scan rebuilds the index."""
+    from khipu_tpu.native.store import NativeNodeDataSource
+
+    src = NativeNodeDataSource(str(tmp_path), "account")
+    upserts = {keccak256(b"v%d" % i): b"v%d" % i for i in range(20)}
+    src.update([], upserts)
+    src.stop()
+    os.unlink(tmp_path / "account.idx")
+    again = NativeNodeDataSource(str(tmp_path), "account")
+    for k, v in upserts.items():
+        assert again.get(k) == v
+    again.stop()
+
+
+def test_storages_native_engine_full_chain(tmp_path):
+    """Storages(engine='native') + MPT over it + restart: identical
+    roots (round-3 brief item 4's 'Done =' bar)."""
+    from khipu_tpu.config import fixture_config
+    from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+    from khipu_tpu.domain.transaction import Transaction, sign_transaction
+    from khipu_tpu.base.crypto.secp256k1 import (
+        privkey_to_pubkey,
+        pubkey_to_address,
+    )
+    from khipu_tpu.storage.storages import Storages
+    from khipu_tpu.sync.chain_builder import ChainBuilder
+
+    cfg = fixture_config(chain_id=1)
+    keys = [(i + 1).to_bytes(32, "big") for i in range(3)]
+    addrs = [pubkey_to_address(privkey_to_pubkey(k)) for k in keys]
+    alloc = {a: 10**21 for a in addrs}
+
+    st = Storages(engine="native", data_dir=str(tmp_path))
+    builder = ChainBuilder(
+        Blockchain(st, cfg), cfg, GenesisSpec(alloc=alloc)
+    )
+    for n in range(3):
+        txs = [
+            sign_transaction(
+                Transaction(n, 10**9, 21000, addrs[(i + 1) % 3], 777),
+                keys[i],
+                chain_id=1,
+            )
+            for i in range(3)
+        ]
+        builder.add_block(txs, coinbase=b"\xaa" * 20)
+    head = builder.head
+    st.stop()
+
+    st2 = Storages(engine="native", data_dir=str(tmp_path))
+    bc2 = Blockchain(st2, cfg)
+    assert bc2.best_block_number == 3
+    h = bc2.get_header_by_number(3)
+    assert h.hash == head.hash
+    world = bc2.get_world_state(h.state_root)
+    assert world.get_balance(addrs[0]) > 0
+    assert bc2.get_account(addrs[1], h.state_root).nonce == 3
+    st2.stop()
