@@ -1,19 +1,29 @@
 #!/usr/bin/env python
-"""Driver benchmark: BASELINE config #2 — Keccak-256 over 1M random
-576-byte RLP-trie-node-sized messages, single batched Pallas kernel.
+"""Driver benchmark, one JSON line per BASELINE config (primary last).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Configs (BASELINE.md):
+  #1 regular-sync replay, early-era-shaped fixture chain (~3 tx/block),
+     full validation + device trie commit          -> blocks/s
+  #3 100k-account MPT bulk build (one root on device, host/device split)
+  #4 parallel-commit replay, ERC-20-era-shaped blocks (~50 tx/block,
+     optimistic parallel execution + merge)        -> blocks/s, par %
+  #5 snapshot verify: content-address re-hash of 1M 576B nodes (chip-
+     resident; the 10M-node config sharded across a pod runs the same
+     kernel via parallel.keccak_sharded)           -> nodes/s/chip
+  #2 Keccak-256 microbench: 1M x 576B nodes, batched Pallas kernel
+     -> hashes/s/chip (PRIMARY — printed last; the driver records the
+     final line)
 
-vs_baseline compares against optimized *scalar* CPU Keccak measured live
-on this host (hashlib.sha3_256 — same f[1600] permutation as Keccak-256,
-OpenSSL C implementation), standing in for the reference's per-node JVM
-sponge (khipu-base/.../crypto/hash/KeccakCore.scala), which hashes one
-node at a time on one core.
+vs_baseline for #2 compares against optimized *scalar* CPU Keccak
+measured live (hashlib.sha3_256 — same f[1600] permutation, OpenSSL C),
+standing in for the reference's per-node JVM sponge
+(khipu-base/.../crypto/hash/KeccakCore.scala). Device work stays
+resident (the axon tunnel's host<->device link is not representative).
 
-Everything device-side stays resident (generation, padding, hashing):
-the axon TPU tunnel's host<->device link is not representative of real
-PCIe/ICI, and config #2 is an on-chip kernel-throughput metric.
+Mainnet block data is unreachable from this environment (zero egress),
+so #1/#4 replay ChainBuilder fixture chains shaped like their eras;
+state roots are still fully validated per block (the same
+validateBlockAfterExecution gate mainnet replay would use).
 """
 
 import hashlib
@@ -21,7 +31,16 @@ import json
 import sys
 import time
 
-import numpy as np
+
+def emit(metric, value, unit, vs_baseline=0.0, **extra):
+    line = {
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": vs_baseline,
+    }
+    line.update(extra)
+    print(json.dumps(line), flush=True)
 
 
 def cpu_scalar_baseline(length: int = 576, iters: int = 20000) -> float:
@@ -32,28 +51,193 @@ def cpu_scalar_baseline(length: int = 576, iters: int = 20000) -> float:
     return iters / (time.perf_counter() - t0)
 
 
-def main() -> None:
+def bench_replay(n_blocks, txs_per_block, metric, parallel):
+    """Configs #1/#4: build a fixture chain, then time a validated
+    replay into a fresh chain DB with device trie commits."""
+    import dataclasses
+
+    from khipu_tpu.base.crypto.secp256k1 import (
+        privkey_to_pubkey,
+        pubkey_to_address,
+    )
+    from khipu_tpu.config import SyncConfig, fixture_config
+    from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+    from khipu_tpu.domain.transaction import Transaction, sign_transaction
+    from khipu_tpu.storage.storages import Storages
+    from khipu_tpu.sync.chain_builder import ChainBuilder
+    from khipu_tpu.sync.replay import ReplayDriver
+
+    cfg = fixture_config(chain_id=1)
+    cfg = dataclasses.replace(
+        cfg, sync=SyncConfig(parallel_tx=parallel, tx_workers=8)
+    )
+    nsenders = min(max(txs_per_block, 2), 64)
+    keys = [(i + 1).to_bytes(32, "big") for i in range(nsenders)]
+    addrs = [pubkey_to_address(privkey_to_pubkey(k)) for k in keys]
+    # receivers are a DISJOINT address pool: typical blocks pay
+    # addresses that are not also senders in the same block, which is
+    # what makes the reference's ~80% parallel rate achievable
+    receivers = [
+        bytes.fromhex("%040x" % (0xBEEF0000 + i)) for i in range(256)
+    ]
+    alloc = {a: 10**24 for a in addrs}
+
+    builder = ChainBuilder(
+        Blockchain(Storages(), cfg), cfg, GenesisSpec(alloc=alloc)
+    )
+    blocks = []
+    nonces = [0] * nsenders
+    for n in range(n_blocks):
+        txs = []
+        for j in range(txs_per_block):
+            i = j % nsenders
+            txs.append(
+                sign_transaction(
+                    Transaction(
+                        nonces[i], 10**9, 21_000,
+                        receivers[(j * 7 + n) % len(receivers)], 1_000 + n,
+                    ),
+                    keys[i],
+                    chain_id=1,
+                )
+            )
+            nonces[i] += 1
+        blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
+
+    target = Blockchain(Storages(), cfg)
+    target.load_genesis(GenesisSpec(alloc=alloc))
+    driver = ReplayDriver(target, cfg, device_commit=True)
+    stats = driver.replay(blocks)
+    emit(
+        metric,
+        round(stats.blocks_per_s, 2),
+        "blocks/s",
+        txs=stats.txs,
+        parallel_pct=round(
+            100 * stats.parallel_txs / stats.txs if stats.txs else 0
+        ),
+        conflicts=stats.conflicts,
+    )
+
+
+def bench_bulk_build():
+    """Config #3: fresh 100k-account state trie, one root, through the
+    batched device hasher; reports the host-structure vs device-hash
+    split the round-2 verdict asked for."""
+    from khipu_tpu.base.crypto.keccak import keccak256
+    from khipu_tpu.domain.account import Account, address_key
+    from khipu_tpu.trie.bulk import bulk_build, device_hasher
+
+    n = 100_000
+    t0 = time.perf_counter()
+    pairs = [
+        (
+            address_key(i.to_bytes(20, "big")),
+            Account(nonce=0, balance=10**18 + i).encode(),
+        )
+        for i in range(n)
+    ]
+    t_prep = time.perf_counter() - t0
+
+    hash_time = [0.0]
+
+    def timed_hasher(msgs):
+        h0 = time.perf_counter()
+        out = device_hasher(msgs)
+        hash_time[0] += time.perf_counter() - h0
+        return out
+
+    # cold pass compiles the bounded tile-shape set; steady state is
+    # the representative number (every later block/epoch reuses the
+    # compiled shapes)
+    t_cold0 = time.perf_counter()
+    bulk_build(pairs, hasher=device_hasher)
+    cold = time.perf_counter() - t_cold0
+    t1 = time.perf_counter()
+    root, nodes = bulk_build(pairs, hasher=timed_hasher)
+    total = time.perf_counter() - t1
+    # sanity: reopenable root, content-addressed nodes
+    assert len(root) == 32 and len(nodes) > n // 2
+    probe = next(iter(nodes.items()))
+    assert keccak256(probe[1]) == probe[0]
+    emit(
+        "mpt_bulk_build_100k_accounts",
+        round(n / total),
+        "accounts/s",
+        total_s=round(total, 3),
+        device_hash_s=round(hash_time[0], 3),
+        host_structure_s=round(total - hash_time[0], 3),
+        encode_prep_s=round(t_prep, 3),
+        cold_compile_s=round(cold, 3),
+        nodes=len(nodes),
+    )
+
+
+def bench_snapshot_verify(N=1 << 20, L=576):
+    """Config #5 (single-chip form): content-address verification rate —
+    re-hash N nodes and compare to claimed keys, all device-resident."""
     import jax
     import jax.numpy as jnp
+
+    from khipu_tpu.ops.keccak_pallas import _build_device_fixed
+
+    run = _build_device_fixed(L, False)
+    base = jax.random.bits(jax.random.PRNGKey(7), (N, L // 4), jnp.uint32)
+
+    @jax.jit
+    def hash_only(words, salt):
+        data = jax.lax.bitcast_convert_type(words ^ salt, jnp.uint8).reshape(N, L)
+        return run(data)
+
+    @jax.jit
+    def verify(words, salt, claimed):
+        # claimed is an INPUT (precomputed in a separate dispatch), so
+        # the comparison cannot be constant-folded and the kernel stays
+        # live in the timed graph
+        data = jax.lax.bitcast_convert_type(words ^ salt, jnp.uint8).reshape(N, L)
+        digests = run(data)
+        return jnp.sum(jnp.any(digests != claimed, axis=1))
+
+    claims = {
+        i: jax.block_until_ready(hash_only(base, jnp.uint32(i)))
+        for i in range(6)
+    }
+    jax.block_until_ready(verify(base, jnp.uint32(0), claims[0]))
+    times = []
+    for i in range(1, 6):
+        t0 = time.perf_counter()
+        bad = jax.block_until_ready(verify(base, jnp.uint32(i), claims[i]))
+        times.append(time.perf_counter() - t0)
+        assert int(bad) == 0
+    # and a negative control: wrong claims must be detected
+    assert int(verify(base, jnp.uint32(1), claims[2])) > 0
+    dt = sorted(times)[len(times) // 2]
+    emit(
+        "snapshot_verify_576B_nodes_per_sec_per_chip",
+        round(N / dt),
+        "nodes/s/chip",
+    )
+
+
+def bench_keccak_primary():
+    """Config #2 (primary): 1M x 576B batched Keccak on one chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
     from khipu_tpu.base.crypto.keccak import keccak256
     from khipu_tpu.ops.keccak_pallas import _build_device_fixed
 
     N, L = 1 << 20, 576
     run = _build_device_fixed(L, False)
-
-    # Generate 1M random nodes on device (no tunnel transfer).
     base = jax.random.bits(jax.random.PRNGKey(2026), (N, L // 4), jnp.uint32)
 
     @jax.jit
     def step(words, salt):
-        # Derive a fresh input per iteration (device-side xor) so every
-        # dispatch sees a new buffer — reused buffers can be served from
-        # a dispatch cache and time at ~0 ms.
         data = jax.lax.bitcast_convert_type(words ^ salt, jnp.uint8).reshape(N, L)
         return data, run(data)
 
-    # Correctness gate: a wrong kernel benches at zero.
+    # correctness gate: a wrong kernel benches at zero
     data0, digests = jax.block_until_ready(step(base, jnp.uint32(0)))
     rows = np.asarray(jax.device_get(data0[:4]))
     outs = np.asarray(jax.device_get(digests[:4]))
@@ -65,20 +249,25 @@ def main() -> None:
         t0 = time.perf_counter()
         jax.block_until_ready(step(base, jnp.uint32(i))[1])
         times.append(time.perf_counter() - t0)
-    dt = sorted(times)[len(times) // 2]  # median
-    hashes_per_s = N / dt
-
-    baseline = cpu_scalar_baseline(L)
-    print(
-        json.dumps(
-            {
-                "metric": "keccak256_576B_trie_node_hashes_per_sec_per_chip",
-                "value": round(hashes_per_s),
-                "unit": "hashes/s/chip",
-                "vs_baseline": round(hashes_per_s / baseline, 2),
-            }
-        )
+    dt = sorted(times)[len(times) // 2]
+    emit(
+        "keccak256_576B_trie_node_hashes_per_sec_per_chip",
+        round(N / dt),
+        "hashes/s/chip",
+        vs_baseline=round((N / dt) / cpu_scalar_baseline(L), 2),
     )
+
+
+def main() -> None:
+    bench_replay(
+        200, 3, "replay_early_era_fixture_blocks_per_sec", parallel=False
+    )
+    bench_replay(
+        10, 50, "replay_parallel_commit_fixture_blocks_per_sec", parallel=True
+    )
+    bench_bulk_build()
+    bench_snapshot_verify()
+    bench_keccak_primary()  # primary metric: keep LAST
 
 
 if __name__ == "__main__":

@@ -49,8 +49,15 @@ class BlockchainConfig:
     constantinople_block: int = 7_280_000
     petersburg_block: int = 7_280_000
     istanbul_block: int = 9_069_000
-    # difficulty bomb delays (DifficultyCalculator.scala:17)
-    bomb_pause_block: int = 4_370_000  # EIP-649 (-3M)
+    # difficulty-bomb rewind schedule (DifficultyCalculator.scala:17):
+    # (activation_block, total_rewind) pairs, cumulative per EIP-649
+    # (-3M), EIP-1234 (-5M), EIP-2384 (-9M); the largest activated
+    # rewind applies
+    bomb_delays: tuple = (
+        (4_370_000, 3_000_000),
+        (7_280_000, 5_000_000),
+        (9_200_000, 9_000_000),
+    )
     bomb_defuse_block: int = FAR
     monetary_policy: MonetaryPolicy = field(default_factory=MonetaryPolicy)
     max_code_size: int = 24_576  # EIP-170
@@ -102,7 +109,7 @@ def fixture_config(
         constantinople_block=fork_block,
         petersburg_block=fork_block,
         istanbul_block=fork_block,
-        bomb_pause_block=fork_block,
+        bomb_delays=((fork_block, 3_000_000),),
     )
     kwargs.update(overrides)
     return KhipuConfig(blockchain=BlockchainConfig(**kwargs))

@@ -1,0 +1,5 @@
+"""Consensus: Ethash PoW (consensus/pow/ in the reference)."""
+
+from khipu_tpu.consensus.ethash import EthashCache, hashimoto_light, mine
+
+__all__ = ["EthashCache", "hashimoto_light", "mine"]

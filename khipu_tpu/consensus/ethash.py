@@ -1,0 +1,198 @@
+"""Ethash proof-of-work: epoch cache, light dataset items, hashimoto.
+
+Parity: consensus/pow/EthashAlgo.scala:49 (makeCache :76 — seed chain +
+3 rounds of FNV randmemohash; calcDatasetItem :97; hashimoto :143) and
+Ethash.scala:52 (epoch cache management, validate :301). Light
+verification only — full-dataset mining tables are a miner concern; the
+validator computes the handful of dataset items each hashimoto needs
+directly from the cache, which is what validate() does in the reference
+too.
+
+Numpy does the word mixing (the cache is a [n, 16] uint32 array; FNV
+and the 128-byte mix are vectorized); keccak256/512 come from the
+native C++ sponge. Sizes are the spec's by default; tests may pass a
+reduced cache_bytes to keep epoch generation in CI budget (the
+algorithm is size-generic, exactly like the reference's EthashParams).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from khipu_tpu.base.crypto.keccak import keccak256, keccak512
+
+WORD_BYTES = 4
+DATASET_BYTES_INIT = 1 << 30
+DATASET_BYTES_GROWTH = 1 << 23
+CACHE_BYTES_INIT = 1 << 24
+CACHE_BYTES_GROWTH = 1 << 17
+EPOCH_LENGTH = 30_000
+MIX_BYTES = 128
+HASH_BYTES = 64
+DATASET_PARENTS = 256
+CACHE_ROUNDS = 3
+ACCESSES = 64
+FNV_PRIME = 0x01000193
+_U32 = 0xFFFFFFFF
+
+
+def _is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    i = 2
+    while i * i <= n:
+        if n % i == 0:
+            return False
+        i += 1
+    return True
+
+
+def cache_size(epoch: int) -> int:
+    sz = CACHE_BYTES_INIT + CACHE_BYTES_GROWTH * epoch - HASH_BYTES
+    while not _is_prime(sz // HASH_BYTES):
+        sz -= 2 * HASH_BYTES
+    return sz
+
+
+def dataset_size(epoch: int) -> int:
+    sz = DATASET_BYTES_INIT + DATASET_BYTES_GROWTH * epoch - MIX_BYTES
+    while not _is_prime(sz // MIX_BYTES):
+        sz -= 2 * MIX_BYTES
+    return sz
+
+
+def seed_hash(epoch: int) -> bytes:
+    seed = b"\x00" * 32
+    for _ in range(epoch):
+        seed = keccak256(seed)
+    return seed
+
+
+def _fnv(a, b):
+    # widen to u64 for the multiply: u32 * u32 wraps (intentionally) but
+    # numpy warns on scalar overflow; the mask keeps the math identical
+    a64 = np.asarray(a, dtype=np.uint64)
+    return (((a64 * FNV_PRIME) & _U32) ^ np.asarray(b, dtype=np.uint64)).astype(
+        np.uint32
+    )
+
+
+class EthashCache:
+    """One epoch's cache (makeCache :76): seed chain + CACHE_ROUNDS of
+    the RandMemoHash strengthening pass."""
+
+    def __init__(self, epoch: int, cache_bytes: Optional[int] = None):
+        self.epoch = epoch
+        self.seed = seed_hash(epoch)
+        n_bytes = cache_bytes if cache_bytes is not None else cache_size(epoch)
+        n = n_bytes // HASH_BYTES
+        rows = [keccak512(self.seed)]
+        for _ in range(n - 1):
+            rows.append(keccak512(rows[-1]))
+        buf = bytearray(b"".join(rows))
+        view = memoryview(buf)
+        for _ in range(CACHE_ROUNDS):
+            for i in range(n):
+                v = int.from_bytes(view[i * 64 : i * 64 + 4], "little") % n
+                j = (i - 1 + n) % n
+                mixed = bytes(
+                    x ^ y
+                    for x, y in zip(
+                        view[j * 64 : j * 64 + 64], view[v * 64 : v * 64 + 64]
+                    )
+                )
+                view[i * 64 : i * 64 + 64] = keccak512(mixed)
+        self.cache = np.frombuffer(bytes(buf), dtype="<u4").reshape(n, 16)
+        self.n_rows = n
+
+    def calc_dataset_item(self, i: int) -> np.ndarray:
+        """calcDatasetItem :97 — one 64-byte full-dataset item from the
+        cache (DATASET_PARENTS FNV-mixed cache rows)."""
+        n = self.n_rows
+        r = HASH_BYTES // WORD_BYTES  # 16
+        mix = self.cache[i % n].copy()
+        mix[0] ^= i
+        mix = np.frombuffer(keccak512(mix.tobytes()), dtype="<u4").copy()
+        for j in range(DATASET_PARENTS):
+            parent = int(_fnv(np.uint32(i ^ j), mix[j % r])) % n
+            mix = _fnv(mix, self.cache[parent])
+        return np.frombuffer(keccak512(mix.tobytes()), dtype="<u4")
+
+
+def hashimoto_light(
+    cache: EthashCache,
+    header_hash: bytes,
+    nonce: int,
+    full_size: Optional[int] = None,
+) -> Tuple[bytes, bytes]:
+    """hashimoto :143 — returns (mix_digest, result).
+
+    full_size defaults to the epoch's dataset size; reduced-cache tests
+    pass a matching reduced size (must be a multiple of MIX_BYTES).
+    """
+    if full_size is None:
+        full_size = dataset_size(cache.epoch)
+    n = full_size // HASH_BYTES
+    w = MIX_BYTES // WORD_BYTES  # 32
+    mixhashes = MIX_BYTES // HASH_BYTES  # 2
+
+    s_bytes = keccak512(header_hash + nonce.to_bytes(8, "little"))
+    s = np.frombuffer(s_bytes, dtype="<u4")
+    mix = np.tile(s, mixhashes).copy()  # 32 words
+
+    for i in range(ACCESSES):
+        p = (
+            int(_fnv(np.uint32(i ^ s[0]), mix[i % w])) % (n // mixhashes)
+        ) * mixhashes
+        newdata = np.concatenate(
+            [cache.calc_dataset_item(p + j) for j in range(mixhashes)]
+        )
+        mix = _fnv(mix, newdata)
+
+    cmix = np.zeros(w // 4, dtype=np.uint32)
+    for i in range(0, w, 4):
+        cmix[i // 4] = int(
+            _fnv(_fnv(_fnv(mix[i], mix[i + 1]), mix[i + 2]), mix[i + 3])
+        )
+    mix_digest = cmix.tobytes()
+    result = keccak256(s_bytes + mix_digest)
+    return mix_digest, result
+
+
+def check_pow(
+    cache: EthashCache,
+    header_hash: bytes,
+    mix_digest: bytes,
+    nonce: int,
+    difficulty: int,
+    full_size: Optional[int] = None,
+) -> bool:
+    """validate :301: recompute the mix, check digest equality and the
+    2^256/difficulty bound."""
+    if difficulty <= 0:
+        return False  # cheap reject before the 64-access hashimoto
+    mix, result = hashimoto_light(cache, header_hash, nonce, full_size)
+    if mix != mix_digest:
+        return False
+    return int.from_bytes(result, "big") <= (1 << 256) // difficulty
+
+
+def mine(
+    cache: EthashCache,
+    header_hash: bytes,
+    difficulty: int,
+    start_nonce: int = 0,
+    full_size: Optional[int] = None,
+    max_tries: int = 1 << 20,
+) -> Tuple[int, bytes]:
+    """Miner.scala:40 role (light): scan nonces until the bound holds."""
+    if difficulty <= 0:
+        raise ValueError("difficulty must be positive")
+    bound = (1 << 256) // difficulty
+    for nonce in range(start_nonce, start_nonce + max_tries):
+        mix, result = hashimoto_light(cache, header_hash, nonce, full_size)
+        if int.from_bytes(result, "big") <= bound:
+            return nonce, mix
+    raise RuntimeError("nonce space exhausted")

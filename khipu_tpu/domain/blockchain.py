@@ -101,15 +101,19 @@ class Blockchain:
         receipts: List[Receipt],
         total_difficulty: int,
         world: Optional[BlockWorldState] = None,
+        hasher=None,
     ) -> None:
         """saveNewBlock:362: world.persist + all block storages +
-        best-number advance."""
+        best-number advance. ``hasher`` routes the trie commit through
+        the batched device path; the root equality check below gates it
+        against the header either way."""
         s = self.storages
         if world is not None:
             root = world.persist(
                 s.account_node_storage,
                 s.storage_node_storage,
                 s.evmcode_storage,
+                hasher=hasher,
             )
             if root != block.header.state_root:
                 raise ValueError(

@@ -99,13 +99,28 @@ class TrieStorage:
     def is_dirty(self) -> bool:
         return bool(self.logs)
 
-    def flush_into(self, trie: MerklePatriciaTrie) -> MerklePatriciaTrie:
+    def dirty_pairs(self):
+        """(upserts, removes) in trie-key form — zero value => remove
+        (TrieStorage.scala:43-50)."""
+        upserts, removes = [], []
         for key, value in self.logs.items():
             kb = self.key_bytes(key)
             if value == 0:
-                trie = trie.remove(kb)
+                removes.append(kb)
             else:
-                trie = trie.put(kb, rlp_encode(to_minimal_bytes(value)))
+                upserts.append((kb, rlp_encode(to_minimal_bytes(value))))
+        return upserts, removes
+
+    def flush_into(self, trie: MerklePatriciaTrie, hasher=None) -> MerklePatriciaTrie:
+        upserts, removes = self.dirty_pairs()
+        if hasher is not None:
+            from khipu_tpu.trie.deferred import batch_commit
+
+            return batch_commit(trie, upserts, removes, hasher)
+        for kb in removes:
+            trie = trie.remove(kb)
+        for kb, enc in upserts:
+            trie = trie.put(kb, enc)
         return trie
 
 
@@ -411,7 +426,7 @@ class BlockWorldState:
 
     # --------------------------------------------------- commit / root
 
-    def _materialized_accounts(self) -> Dict[bytes, Optional[Account]]:
+    def _materialized_accounts(self, hasher=None) -> Dict[bytes, Optional[Account]]:
         """Resolve logs + deltas + dirty storages + codes into final
         Account records per touched address."""
         out: Dict[bytes, Optional[Account]] = {}
@@ -459,7 +474,7 @@ class BlockWorldState:
                 )
             ts = self.storages.get(addr)
             if ts is not None and ts.is_dirty():
-                new_trie = ts.flush_into(ts.trie)
+                new_trie = ts.flush_into(ts.trie, hasher)
                 acc = Account(
                     nonce=acc.nonce,
                     balance=acc.balance,
@@ -470,21 +485,39 @@ class BlockWorldState:
             out[addr] = acc
         return out
 
-    def flush(self) -> "BlockWorldState":
+    def flush(self, hasher=None) -> "BlockWorldState":
         """Push all logs into the account trie (flush():303). Returns
         self with account_trie advanced and logs cleared; storage-trie
-        and code changes are retained for persist()."""
+        and code changes are retained for persist().
+
+        With ``hasher`` set, every trie commit (storage tries + the
+        account trie) runs through the level-synchronous deferred path
+        (trie.deferred.batch_commit) — one batched Keccak call per node
+        level, the TPU-commit integration of SURVEY §2.8(c). hasher=None
+        keeps the eager host MPT (the bit-exactness oracle)."""
         self._flushed_storage_tries: Dict[bytes, MerklePatriciaTrie] = {}
-        final = self._materialized_accounts()
-        trie = self.account_trie
+        final = self._materialized_accounts(hasher)
+        upserts, removes = [], []
         for addr in sorted(final):
             acc = final[addr]
             key = address_key(addr)
             if acc is None:
-                trie = trie.remove(key)
+                removes.append(key)
             else:
-                trie = trie.put(key, acc.encode())
-        self.account_trie = trie
+                upserts.append((key, acc.encode()))
+        if hasher is not None:
+            from khipu_tpu.trie.deferred import batch_commit
+
+            self.account_trie = batch_commit(
+                self.account_trie, upserts, removes, hasher
+            )
+        else:
+            trie = self.account_trie
+            for key in removes:
+                trie = trie.remove(key)
+            for key, enc in upserts:
+                trie = trie.put(key, enc)
+            self.account_trie = trie
         self._pending_codes = {
             keccak256(code): code for code in self.codes.values() if code
         }
@@ -500,10 +533,11 @@ class BlockWorldState:
         pre-flush world stays intact (TrieAccounts.scala:73-80)."""
         return self.copy().flush().account_trie.root_hash
 
-    def persist(self, account_node_storage, storage_node_storage, evmcode_storage) -> bytes:
+    def persist(self, account_node_storage, storage_node_storage,
+                evmcode_storage, hasher=None) -> bytes:
         """flush + write dirty nodes to the three NodeStorages
         (persist():312-330). Returns the new state root."""
-        self.flush()
+        self.flush(hasher)
         for trie in getattr(self, "_flushed_storage_tries", {}).values():
             removed, upserts = trie.changes()
             storage_node_storage.update(removed, upserts)

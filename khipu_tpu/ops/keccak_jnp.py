@@ -178,8 +178,17 @@ def pad_batch_count(n: int, floor: int = 16) -> int:
     return target
 
 
-def keccak256_batch_jnp(messages: Sequence[bytes]) -> List[bytes]:
-    """Hash a batch of variable-length messages, bucketing by block count."""
+def bucketed_batch(messages, target_count, run_bucket) -> List[bytes]:
+    """Shared bucket/pad/scatter frame for every batched-hash backend.
+
+    Buckets messages by rate-block class, pads each bucket with minimal-
+    size filler messages up to ``target_count(nblocks, n)`` (bounding
+    jit specializations), dispatches ``run_bucket(nblocks, msgs) ->
+    digests`` (may return extra padding digests), and scatters results
+    back into input order. Backends: jnp absorb (here), the Pallas tile
+    kernel (ops.keccak_pallas), and the mesh-sharded absorb
+    (parallel.keccak_sharded) — one frame, three dispatchers.
+    """
     if not messages:
         return []
     buckets = {}
@@ -188,11 +197,22 @@ def keccak256_batch_jnp(messages: Sequence[bytes]) -> List[bytes]:
     out: List = [None] * len(messages)
     for nblocks, idxs in sorted(buckets.items()):
         msgs = [messages[i] for i in idxs]
-        # pad bucket to a fixed size class to bound jit specializations
         filler = b"\x00" * ((nblocks - 1) * RATE)
-        msgs += [filler] * (pad_batch_count(len(msgs)) - len(msgs))
-        blocks = pad_to_blocks(msgs, nblocks)
-        words = absorb(jnp.asarray(blocks), nblocks)
-        for i, digest in zip(idxs, digests_to_bytes(jax.device_get(words))):
+        msgs += [filler] * (target_count(nblocks, len(msgs)) - len(msgs))
+        digests = run_bucket(nblocks, msgs)
+        for i, digest in zip(idxs, digests):
             out[i] = digest
     return out
+
+
+def keccak256_batch_jnp(messages: Sequence[bytes]) -> List[bytes]:
+    """Hash a batch of variable-length messages, bucketing by block count."""
+
+    def run_bucket(nblocks, msgs):
+        blocks = pad_to_blocks(msgs, nblocks)
+        words = absorb(jnp.asarray(blocks), nblocks)
+        return digests_to_bytes(jax.device_get(words))
+
+    return bucketed_batch(
+        messages, lambda nblocks, n: pad_batch_count(n), run_bucket
+    )

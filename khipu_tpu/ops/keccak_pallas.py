@@ -161,32 +161,49 @@ def retile(blocks: np.ndarray) -> np.ndarray:
     )
 
 
+# Largest per-dispatch tile count: batches above this are CHUNKED into
+# equal dispatches of exactly MAX_TILES tiles, so the set of compiled
+# shapes per rate-block class is {1, 2, 4, 8, 16} tiles — a one-off
+# compile budget instead of a new 10s+ XLA compile per batch size
+# (bulk-build levels arrive in arbitrary sizes).
+MAX_TILES = 16
+
+
+def _pallas_target_count(nblocks: int, n: int) -> int:
+    """Whole tiles, power-of-two tile count up to MAX_TILES, then whole
+    multiples of MAX_TILES (bounds compiled shapes to {1,2,4,8,16})."""
+    n_tiles_raw = (n + TILE - 1) // TILE
+    if n_tiles_raw <= MAX_TILES:
+        return pad_batch_count(n, floor=TILE)
+    n_chunks = (n_tiles_raw + MAX_TILES - 1) // MAX_TILES
+    return n_chunks * MAX_TILES * TILE
+
+
 def keccak256_batch_pallas(
     messages: Sequence[bytes], interpret: bool = False
 ) -> List[bytes]:
     """Hash variable-length messages via the Pallas kernel.
 
     Buckets by rate-block count, zero-pads each bucket to a whole
-    1024-message tile (padding digests discarded).
+    1024-message tile (padding digests discarded), chunks at MAX_TILES.
     """
-    if not messages:
-        return []
-    buckets = {}
-    for idx, m in enumerate(messages):
-        buckets.setdefault(len(m) // RATE + 1, []).append(idx)
-    out: List = [None] * len(messages)
-    for nblocks, idxs in sorted(buckets.items()):
-        msgs = [messages[i] for i in idxs]
-        # whole tiles AND power-of-two tile count, to bound jit specializations
-        filler = b"\x00" * ((nblocks - 1) * RATE)
-        msgs += [filler] * (pad_batch_count(len(msgs), floor=TILE) - len(msgs))
+    from khipu_tpu.ops.keccak_jnp import bucketed_batch
+
+    def run_bucket(nblocks, msgs):
         packed = pad_to_blocks(msgs, nblocks)
         tiled = retile(packed)
-        words = _build(nblocks, interpret)(jnp.asarray(tiled))
-        arr = np.asarray(jax.device_get(words), dtype="<u4")  # (tiles, 8, 8, 128)
-        # invert retile: digest j lives at [j//1024, :, (j%1024)//128, j%128]
-        for pos, i in enumerate(idxs):
+        run = _build(nblocks, interpret)
+        chunks = []
+        for start in range(0, tiled.shape[0], MAX_TILES):
+            words = run(jnp.asarray(tiled[start : start + MAX_TILES]))
+            chunks.append(np.asarray(jax.device_get(words), dtype="<u4"))
+        arr = np.concatenate(chunks, axis=0)  # (tiles, 8, 8, 128)
+        # invert retile: digest j is at [j//1024, :, (j%1024)//128, j%128]
+        digests = []
+        for pos in range(len(msgs)):
             t, r = divmod(pos, TILE)
-            s, l = divmod(r, 128)
-            out[i] = arr[t, :, s, l].tobytes()
-    return out
+            sub, lane = divmod(r, 128)
+            digests.append(arr[t, :, sub, lane].tobytes())
+        return digests
+
+    return bucketed_batch(messages, _pallas_target_count, run_bucket)

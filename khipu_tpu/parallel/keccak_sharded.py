@@ -147,6 +147,60 @@ def hash_level_all_gather(data: np.ndarray, mesh: Mesh) -> np.ndarray:
     return np.asarray(jax.device_get(out))[:n]
 
 
+@functools.lru_cache(maxsize=64)
+def _build_sharded_absorb(nblocks: int, mesh: Mesh):
+    """shard_map over the batch dim of pre-padded word-major blocks:
+    uint32[nblocks, 34, B] -> uint32[8, B], B split across the mesh."""
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(None, None, AXIS),
+        out_specs=P(None, AXIS),
+    )
+    def absorb_shard(blocks):
+        return absorb(blocks, nblocks)
+
+    return jax.jit(absorb_shard)
+
+
+def keccak256_batch_sharded(messages, mesh: Mesh):
+    """Variable-length batch hashing across the mesh: the Hasher shape
+    (Sequence[bytes] -> List[bytes]) that bulk_build / batch_commit
+    take, so whole-trie builds and block commits shard over chips
+    (SURVEY §2.8(c); round-3 brief item 6).
+
+    Buckets by rate-block class (like ops.keccak), pads each bucket to
+    a multiple of the mesh size, splits the batch dim over the mesh.
+    """
+    from khipu_tpu.ops.keccak_jnp import (
+        bucketed_batch,
+        digests_to_bytes,
+        pad_batch_count,
+        pad_to_blocks,
+    )
+
+    n_shards = mesh.devices.size
+
+    def run_bucket(nblocks, msgs):
+        blocks = pad_to_blocks(msgs, nblocks)  # [nblocks, 34, B]
+        with mesh:
+            words = _build_sharded_absorb(nblocks, mesh)(jnp.asarray(blocks))
+        return digests_to_bytes(jax.device_get(words))
+
+    return bucketed_batch(
+        messages,
+        lambda nblocks, n: pad_batch_count(n, floor=n_shards),
+        run_bucket,
+    )
+
+
+def sharded_hasher(mesh: Mesh):
+    """Bind a mesh into a Hasher usable by trie.bulk.bulk_build and
+    trie.deferred.batch_commit."""
+    return lambda messages: keccak256_batch_sharded(messages, mesh)
+
+
 def snapshot_verify_sharded(
     values: np.ndarray, keys: np.ndarray, mesh: Mesh
 ) -> int:

@@ -17,6 +17,7 @@ from khipu_tpu.domain.block import Block, BlockBody
 from khipu_tpu.domain.block_header import EMPTY_OMMERS_HASH, BlockHeader
 from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
 from khipu_tpu.domain.transaction import SignedTransaction
+from khipu_tpu.domain.difficulty import calc_difficulty
 from khipu_tpu.ledger.bloom import bloom_union
 from khipu_tpu.ledger.ledger import execute_block
 from khipu_tpu.validators.roots import receipts_root, transactions_root
@@ -41,6 +42,11 @@ class ChainBuilder:
         extra_data: bytes = b"",
     ) -> Block:
         parent = self.head.header
+        ts = (
+            timestamp
+            if timestamp is not None
+            else parent.unix_timestamp + 13
+        )
         header = BlockHeader(
             parent_hash=parent.hash,
             ommers_hash=EMPTY_OMMERS_HASH,
@@ -49,15 +55,14 @@ class ChainBuilder:
             transactions_root=transactions_root(txs),
             receipts_root=b"\x00" * 32,
             logs_bloom=b"\x00" * 256,
-            difficulty=parent.difficulty,
+            # consensus-true difficulty so replay can validate headers
+            difficulty=calc_difficulty(
+                ts, parent, self.config.blockchain
+            ),
             number=parent.number + 1,
             gas_limit=parent.gas_limit,
             gas_used=0,
-            unix_timestamp=(
-                timestamp
-                if timestamp is not None
-                else parent.unix_timestamp + 13
-            ),
+            unix_timestamp=ts,
             extra_data=extra_data,
         )
         draft = Block(header, BlockBody(tuple(txs)))

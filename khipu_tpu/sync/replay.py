@@ -18,6 +18,7 @@ from typing import Callable, Iterable, List, Optional
 from khipu_tpu.config import KhipuConfig
 from khipu_tpu.domain.block import Block
 from khipu_tpu.domain.blockchain import Blockchain
+from khipu_tpu.domain.difficulty import calc_difficulty
 from khipu_tpu.ledger.ledger import execute_block
 from khipu_tpu.validators.validators import (
     BlockHeaderValidator,
@@ -48,12 +49,27 @@ class ReplayDriver:
         config: KhipuConfig,
         log: Optional[Callable[[str], None]] = None,
         validate_headers: bool = True,
+        device_commit: bool = False,
     ):
         self.blockchain = blockchain
         self.config = config
         self.log = log
-        self.header_validator = BlockHeaderValidator(config.blockchain)
+        self.header_validator = BlockHeaderValidator(
+            config.blockchain,
+            difficulty_fn=lambda h, p: calc_difficulty(
+                h.unix_timestamp, p, config.blockchain
+            ),
+        )
         self.validate_headers = validate_headers
+        # route dirty-node hashing of every block commit through the
+        # batched device path (Pallas on TPU); save_block's persisted-
+        # root == header.state_root check gates it per block
+        if device_commit:
+            from khipu_tpu.trie.bulk import device_hasher
+
+            self.hasher = device_hasher
+        else:
+            self.hasher = None
 
     def replay(self, blocks: Iterable[Block]) -> ReplayStats:
         """executeAndInsertBlocks: serial fold with full validation."""
@@ -85,7 +101,7 @@ class ReplayDriver:
             self.blockchain.get_total_difficulty(parent.number) or 0
         ) + header.difficulty
         self.blockchain.save_block(
-            block, result.receipts, td, result.world
+            block, result.receipts, td, result.world, hasher=self.hasher
         )
         dt = time.perf_counter() - t0
 

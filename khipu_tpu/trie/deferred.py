@@ -1,0 +1,200 @@
+"""Deferred-hash MPT commit: incremental updates with level-synchronous
+batched hashing.
+
+``bulk.py`` builds *fresh* tries batch-wise; block execution instead
+produces a few hundred dirty keys against an EXISTING trie. The eager
+MPT hashes each rebuilt node on the host as it goes (HOT LOOP 3,
+SURVEY §3.2); here the same update machinery runs with hashing
+*deferred*: ``_ref`` hands out 32-byte placeholder refs and records the
+encoding, and ``finalize`` resolves the placeholder DAG bottom-up — one
+batched Keccak call per dependency level (khipu_tpu.ops.keccak — the
+Pallas kernel on TPU). This is SURVEY §2.8(c)'s level-synchronous
+commit applied to incremental updates, and reuses MerklePatriciaTrie's
+insert/delete/capping logic verbatim so bit-exactness is inherited, not
+re-proven.
+
+Placeholders are 32 bytes (same length as a real hash), so every RLP
+length — and therefore every <32-byte inline ("capped") decision — is
+identical to the eager path. A node containing a placeholder child is
+necessarily >= 33 bytes encoded, so placeholders can never hide inside
+an inlined child.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from khipu_tpu.base.rlp import rlp_decode, rlp_encode
+from khipu_tpu.trie.bulk import Hasher, host_hasher
+from khipu_tpu.trie.mpt import BLANK, MerklePatriciaTrie
+
+_PLACEHOLDER_PREFIX = b"\xfe\xc0khipu-deferred\xc0\xfe"  # 18 bytes
+
+
+def _make_placeholder(counter: int) -> bytes:
+    return _PLACEHOLDER_PREFIX + counter.to_bytes(14, "big")
+
+
+def _is_placeholder(ref) -> bool:
+    return (
+        isinstance(ref, bytes)
+        and len(ref) == 32
+        and ref.startswith(_PLACEHOLDER_PREFIX)
+    )
+
+
+class DeferredMPT(MerklePatriciaTrie):
+    """MerklePatriciaTrie whose freshly created nodes get placeholder
+    refs instead of eager keccak256 calls. Call :func:`finalize` (or
+    :meth:`commit`) to resolve."""
+
+    def __init__(self, source, root_hash=None, _root_ref=None,
+                 _logs=None, _staged=None):
+        super().__init__(
+            source, root_hash=root_hash, _root_ref=_root_ref,
+            _logs=_logs, _staged=_staged,
+        )
+        self._counter = [0]  # shared across _child() copies
+
+    def _child(self) -> "DeferredMPT":
+        t = DeferredMPT(self.source)
+        t._root_ref = self._root_ref
+        t._logs = self._logs
+        t._staged = self._staged
+        t._counter = self._counter
+        return t
+
+    def _ref(self, node):
+        if node == BLANK:
+            return BLANK
+        encoded = rlp_encode(node)
+        if len(encoded) < 32:
+            return node
+        ph = _make_placeholder(self._counter[0])
+        self._counter[0] += 1
+        self._staged[ph] = encoded
+        self._log_update(ph, encoded)
+        return ph
+
+    def commit(self, hasher: Hasher = host_hasher) -> MerklePatriciaTrie:
+        """Resolve all placeholders; returns an ordinary trie whose
+        logs/staged/root carry real hashes."""
+        return finalize(self, hasher)
+
+
+def _substitute(structure, mapping: Dict[bytes, bytes]):
+    """Replace placeholder refs inside a decoded node structure."""
+    if isinstance(structure, bytes):
+        return mapping.get(structure, structure)
+    return [_substitute(item, mapping) for item in structure]
+
+
+def _collect_placeholders(structure, out: List[bytes]) -> None:
+    if isinstance(structure, bytes):
+        if _is_placeholder(structure):
+            out.append(structure)
+        return
+    for item in structure:
+        _collect_placeholders(item, out)
+
+
+def finalize(trie: DeferredMPT, hasher: Hasher = host_hasher) -> MerklePatriciaTrie:
+    """Hash the live placeholder DAG bottom-up, one batch per level.
+
+    Dead placeholders (created then superseded within the same session;
+    net refcount 0) were already dropped by the MPT's refcount log.
+    """
+    # live placeholders: positive log entries with placeholder keys
+    live: Dict[bytes, bytes] = {}  # placeholder -> encoded (raw)
+    removed: Dict[bytes, List] = {}
+    for h, rec in trie._logs.items():
+        if _is_placeholder(h):
+            if rec[0] > 0:
+                live[h] = rec[1]
+            # negative placeholder records are impossible: a placeholder
+            # starts at +1 and a net removal deletes the entry
+        else:
+            removed[h] = rec
+
+    structures = {ph: rlp_decode(enc) for ph, enc in live.items()}
+    deps: Dict[bytes, List[bytes]] = {}
+    for ph, struct in structures.items():
+        children: List[bytes] = []
+        _collect_placeholders(struct, children)
+        deps[ph] = children
+
+    resolved: Dict[bytes, bytes] = {}  # placeholder -> real hash
+    final_encoded: Dict[bytes, bytes] = {}  # real hash -> final rlp
+    pending = dict(deps)
+    while pending:
+        level = [
+            ph
+            for ph, children in pending.items()
+            if all(c in resolved for c in children)
+        ]
+        if not level:
+            raise AssertionError("placeholder dependency cycle")
+        encodings = []
+        for ph in level:
+            final = rlp_encode(_substitute(structures[ph], resolved))
+            encodings.append(final)
+        digests = hasher(encodings)
+        for ph, enc, digest in zip(level, encodings, digests):
+            resolved[ph] = digest
+            final_encoded[digest] = enc
+            del pending[ph]
+
+    # rebuild logs: resolved placeholders become Updated(real) records;
+    # removal records for pre-existing hashes pass through. Two
+    # placeholders can resolve to the SAME hash (identical subtrees) —
+    # refcounts add.
+    new_logs: Dict[bytes, List] = {h: [rec[0], rec[1]] for h, rec in removed.items()}
+    for ph, enc in live.items():
+        digest = resolved[ph]
+        count = trie._logs[ph][0]
+        rec = new_logs.get(digest)
+        if rec is None:
+            new_logs[digest] = [count, final_encoded[digest]]
+        else:
+            rec[0] += count
+            rec[1] = final_encoded[digest]
+            if rec[0] == 0:
+                del new_logs[digest]
+
+    new_staged = {
+        resolved[ph]: final_encoded[resolved[ph]] for ph in live
+    }
+    root_ref = trie._root_ref
+    if _is_placeholder(root_ref):
+        root_ref = resolved[root_ref]
+    elif isinstance(root_ref, list):
+        root_ref = rlp_decode(
+            rlp_encode(_substitute(root_ref, resolved))
+        )
+    return MerklePatriciaTrie(
+        trie.source, _root_ref=root_ref, _logs=new_logs, _staged=new_staged
+    )
+
+
+def batch_commit(
+    trie: MerklePatriciaTrie,
+    upserts: Sequence[Tuple[bytes, bytes]],
+    removes: Sequence[bytes] = (),
+    hasher: Hasher = host_hasher,
+) -> MerklePatriciaTrie:
+    """Apply a batch of updates to an existing trie with all node
+    hashing deferred into level batches. Drop-in replacement for a
+    put/remove fold — roots are bit-exact (tests fuzz the equality)."""
+    # deep-copy log records: the MPT mutates them in place, and the
+    # caller's trie must stay untouched
+    d = DeferredMPT(
+        trie.source,
+        _root_ref=trie._root_ref,
+        _logs={h: [c, e] for h, (c, e) in trie._logs.items()},
+        _staged=dict(trie._staged),
+    )
+    for key in removes:
+        d = d.remove(key)
+    for key, value in upserts:
+        d = d.put(key, value)
+    return d.commit(hasher)
