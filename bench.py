@@ -51,9 +51,10 @@ def cpu_scalar_baseline(length: int = 576, iters: int = 20000) -> float:
     return iters / (time.perf_counter() - t0)
 
 
-def bench_replay(n_blocks, txs_per_block, metric, parallel):
+def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1):
     """Configs #1/#4: build a fixture chain, then time a validated
-    replay into a fresh chain DB with device trie commits."""
+    replay into a fresh chain DB with device trie commits (windowed:
+    one batched device pass per `window` blocks)."""
     import dataclasses
 
     from khipu_tpu.base.crypto.secp256k1 import (
@@ -69,7 +70,11 @@ def bench_replay(n_blocks, txs_per_block, metric, parallel):
 
     cfg = fixture_config(chain_id=1)
     cfg = dataclasses.replace(
-        cfg, sync=SyncConfig(parallel_tx=parallel, tx_workers=8)
+        cfg,
+        sync=SyncConfig(
+            parallel_tx=parallel, tx_workers=8,
+            commit_window_blocks=window,
+        ),
     )
     nsenders = min(max(txs_per_block, 2), 64)
     keys = [(i + 1).to_bytes(32, "big") for i in range(nsenders)]
@@ -104,6 +109,13 @@ def bench_replay(n_blocks, txs_per_block, metric, parallel):
             nonces[i] += 1
         blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
 
+    # decode fresh objects: replay must pay sender recovery + RLP parse
+    # like a real sync would (the built objects carry cached senders)
+    from khipu_tpu.domain.block import Block as _Block
+
+    wire = [b.encode() for b in blocks]
+    blocks = [_Block.decode(w) for w in wire]
+
     target = Blockchain(Storages(), cfg)
     target.load_genesis(GenesisSpec(alloc=alloc))
     driver = ReplayDriver(target, cfg, device_commit=True)
@@ -117,6 +129,7 @@ def bench_replay(n_blocks, txs_per_block, metric, parallel):
             100 * stats.parallel_txs / stats.txs if stats.txs else 0
         ),
         conflicts=stats.conflicts,
+        window=window,
     )
 
 
@@ -260,10 +273,12 @@ def bench_keccak_primary():
 
 def main() -> None:
     bench_replay(
-        200, 3, "replay_early_era_fixture_blocks_per_sec", parallel=False
+        200, 3, "replay_early_era_fixture_blocks_per_sec",
+        parallel=False, window=50,
     )
     bench_replay(
-        10, 50, "replay_parallel_commit_fixture_blocks_per_sec", parallel=True
+        10, 50, "replay_parallel_commit_fixture_blocks_per_sec",
+        parallel=True, window=10,
     )
     bench_bulk_build()
     bench_snapshot_verify()

@@ -1,0 +1,6 @@
+"""JSON-RPC API (khipu-eth/.../jsonrpc/ role)."""
+
+from khipu_tpu.jsonrpc.eth_service import EthService
+from khipu_tpu.jsonrpc.server import JsonRpcServer
+
+__all__ = ["EthService", "JsonRpcServer"]

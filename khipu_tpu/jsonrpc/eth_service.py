@@ -1,0 +1,323 @@
+"""eth_* / net_* / web3_* method implementations.
+
+Parity: jsonrpc/EthService.scala (getBalance/call/estimateGas/
+getBlockByNumber/... backed by Blockchain + Ledger.simulateTransaction),
+NetService, Web3Service. Hex-string codecs follow the JSON-RPC spec
+("quantities" minimal-hex, "data" even-length 0x-prefixed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.config import KhipuConfig
+from khipu_tpu.domain.block import Block
+from khipu_tpu.domain.blockchain import Blockchain
+from khipu_tpu.domain.receipt import Receipt
+from khipu_tpu.domain.transaction import (
+    SignedTransaction,
+    contract_address,
+)
+from khipu_tpu.ledger.bloom import bloom_of_logs
+from khipu_tpu.ledger.simulate import estimate_gas, simulate_call
+from khipu_tpu.txpool import PendingTransactionsPool
+
+CLIENT_VERSION = "khipu-tpu/0.3"
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def qty(n: int) -> str:
+    return hex(n)
+
+
+def data(b: Optional[bytes]) -> Optional[str]:
+    return "0x" + b.hex() if b is not None else None
+
+
+def parse_qty(s: Union[str, int]) -> int:
+    if isinstance(s, int):
+        return s
+    return int(s, 16)
+
+
+def parse_data(s: str) -> bytes:
+    return bytes.fromhex(s[2:] if s.startswith("0x") else s)
+
+
+class EthService:
+    def __init__(
+        self,
+        blockchain: Blockchain,
+        config: KhipuConfig,
+        tx_pool: Optional[PendingTransactionsPool] = None,
+    ):
+        self.blockchain = blockchain
+        self.config = config
+        self.tx_pool = tx_pool or PendingTransactionsPool()
+
+    # ------------------------------------------------------- block tags
+
+    def _resolve_block(self, tag: Union[str, int]) -> int:
+        if isinstance(tag, int):
+            return tag
+        if tag in ("latest", "pending", "safe", "finalized"):
+            return self.blockchain.best_block_number
+        if tag == "earliest":
+            return 0
+        return parse_qty(tag)
+
+    def _header(self, tag):
+        n = self._resolve_block(tag)
+        h = self.blockchain.get_header_by_number(n)
+        if h is None:
+            raise RpcError(-32000, f"unknown block {tag}")
+        return h
+
+    # ------------------------------------------------------------- web3
+
+    def web3_clientVersion(self) -> str:
+        return CLIENT_VERSION
+
+    def web3_sha3(self, payload: str) -> str:
+        return data(keccak256(parse_data(payload)))
+
+    def net_version(self) -> str:
+        return str(self.config.blockchain.chain_id)
+
+    def eth_chainId(self) -> str:
+        return qty(self.config.blockchain.chain_id)
+
+    def eth_protocolVersion(self) -> str:
+        return qty(63)  # PV63 (SURVEY §2.7 wire messages)
+
+    # -------------------------------------------------------------- eth
+
+    def eth_blockNumber(self) -> str:
+        return qty(self.blockchain.best_block_number)
+
+    def eth_getBalance(self, address: str, tag="latest") -> str:
+        header = self._header(tag)
+        acc = self.blockchain.get_account(
+            parse_data(address), header.state_root
+        )
+        return qty(acc.balance if acc else 0)
+
+    def eth_getTransactionCount(self, address: str, tag="latest") -> str:
+        header = self._header(tag)
+        addr = parse_data(address)
+        acc = self.blockchain.get_account(addr, header.state_root)
+        count = acc.nonce if acc else 0
+        if tag == "pending":
+            # pooled txs advance the usable nonce (wallets pick the next
+            # nonce from the pending count)
+            count += sum(
+                1 for stx in self.tx_pool.pending() if stx.sender == addr
+            )
+        return qty(count)
+
+    def eth_getCode(self, address: str, tag="latest") -> str:
+        header = self._header(tag)
+        world = self.blockchain.get_world_state(header.state_root)
+        return data(world.get_code(parse_data(address)))
+
+    def eth_getStorageAt(self, address: str, slot: str, tag="latest") -> str:
+        header = self._header(tag)
+        world = self.blockchain.get_world_state(header.state_root)
+        value = world.get_storage(parse_data(address), parse_qty(slot))
+        return data(value.to_bytes(32, "big"))
+
+    def eth_gasPrice(self) -> str:
+        return qty(10**9)
+
+    def eth_getBlockByNumber(self, tag, full_txs: bool = False):
+        n = self._resolve_block(tag)
+        block = self.blockchain.get_block_by_number(n)
+        if block is None:
+            return None
+        return self._block_json(block, full_txs)
+
+    def eth_getBlockByHash(self, block_hash: str, full_txs: bool = False):
+        n = self.blockchain.storages.block_numbers.number_of(
+            parse_data(block_hash)
+        )
+        if n is None:
+            return None
+        return self.eth_getBlockByNumber(n, full_txs)
+
+    def eth_getTransactionByHash(self, tx_hash: str):
+        h = parse_data(tx_hash)
+        loc = self.blockchain.storages.transaction_storage.get(h)
+        if loc is None:
+            pending = self.tx_pool.get(h)
+            if pending is None:
+                return None
+            return self._tx_json(pending, None, None)
+        number, index = loc
+        block = self.blockchain.get_block_by_number(number)
+        if block is None or index >= len(block.body.transactions):
+            return None
+        return self._tx_json(block.body.transactions[index], block, index)
+
+    def eth_getTransactionReceipt(self, tx_hash: str):
+        h = parse_data(tx_hash)
+        loc = self.blockchain.storages.transaction_storage.get(h)
+        if loc is None:
+            return None
+        number, index = loc
+        block = self.blockchain.get_block_by_number(number)
+        receipts = self.blockchain.get_receipts(number)
+        if block is None or receipts is None or index >= len(receipts):
+            return None
+        r = receipts[index]
+        prev_gas = receipts[index - 1].cumulative_gas_used if index else 0
+        stx = block.body.transactions[index]
+        # logIndex is the log's position within the BLOCK (spec), so
+        # count the logs of every earlier receipt first
+        log_base = sum(len(rc.logs) for rc in receipts[:index])
+        out: Dict[str, Any] = {
+            "transactionHash": data(h),
+            "transactionIndex": qty(index),
+            "blockHash": data(block.hash),
+            "blockNumber": qty(number),
+            "from": data(stx.sender),
+            "to": data(stx.tx.to),
+            "contractAddress": (
+                data(contract_address(stx.sender, stx.tx.nonce))
+                if stx.tx.is_contract_creation and stx.sender
+                else None
+            ),
+            "cumulativeGasUsed": qty(r.cumulative_gas_used),
+            "gasUsed": qty(r.cumulative_gas_used - prev_gas),
+            "logsBloom": data(r.logs_bloom),
+            "logs": [
+                {
+                    "address": data(log.address),
+                    "topics": [data(t) for t in log.topics],
+                    "data": data(log.data),
+                    "blockNumber": qty(number),
+                    "blockHash": data(block.hash),
+                    "transactionHash": data(h),
+                    "transactionIndex": qty(index),
+                    "logIndex": qty(log_base + i),
+                }
+                for i, log in enumerate(r.logs)
+            ],
+        }
+        if isinstance(r.post_tx_state, int):
+            out["status"] = qty(r.post_tx_state)
+        else:
+            out["root"] = data(r.post_tx_state)
+        return out
+
+    def eth_call(self, call: dict, tag="latest") -> str:
+        header = self._header(tag)
+        result = simulate_call(
+            self.blockchain.get_world_state, header, self.config,
+            **self._call_kwargs(call),
+        )
+        if result.is_revert:
+            raise RpcError(3, "execution reverted: 0x" + result.output.hex())
+        if result.error:
+            raise RpcError(-32000, result.error)
+        return data(result.output)
+
+    def eth_estimateGas(self, call: dict, tag="latest") -> str:
+        header = self._header(tag)
+        try:
+            return qty(
+                estimate_gas(
+                    self.blockchain.get_world_state, header, self.config,
+                    **self._call_kwargs(call),
+                )
+            )
+        except ValueError as e:
+            raise RpcError(-32000, str(e))
+
+    def eth_sendRawTransaction(self, raw: str) -> str:
+        stx = SignedTransaction.decode(parse_data(raw))
+        if stx.sender is None:
+            raise RpcError(-32000, "invalid signature")
+        self.tx_pool.add(stx)
+        return data(stx.hash)
+
+    def eth_pendingTransactions(self) -> List[dict]:
+        return [
+            self._tx_json(stx, None, None) for stx in self.tx_pool.pending()
+        ]
+
+    def eth_syncing(self):
+        return False
+
+    # ------------------------------------------------------------ codecs
+
+    @staticmethod
+    def _call_kwargs(call: dict) -> dict:
+        out: Dict[str, Any] = {}
+        if call.get("from"):
+            out["sender"] = parse_data(call["from"])
+        if call.get("to"):
+            out["to"] = parse_data(call["to"])
+        if call.get("gas"):
+            out["gas"] = parse_qty(call["gas"])
+        if call.get("gasPrice"):
+            out["gas_price"] = parse_qty(call["gasPrice"])
+        if call.get("value"):
+            out["value"] = parse_qty(call["value"])
+        if call.get("data") or call.get("input"):
+            out["data"] = parse_data(call.get("data") or call.get("input"))
+        return out
+
+    def _tx_json(self, stx: SignedTransaction, block, index):
+        tx = stx.tx
+        return {
+            "hash": data(stx.hash),
+            "nonce": qty(tx.nonce),
+            "from": data(stx.sender),
+            "to": data(tx.to),
+            "value": qty(tx.value),
+            "gas": qty(tx.gas_limit),
+            "gasPrice": qty(tx.gas_price),
+            "input": data(tx.payload),
+            "v": qty(stx.v),
+            "r": qty(stx.r),
+            "s": qty(stx.s),
+            "blockHash": data(block.hash) if block else None,
+            "blockNumber": qty(block.number) if block else None,
+            "transactionIndex": qty(index) if index is not None else None,
+        }
+
+    def _block_json(self, block: Block, full_txs: bool):
+        h = block.header
+        return {
+            "number": qty(h.number),
+            "hash": data(block.hash),
+            "parentHash": data(h.parent_hash),
+            "sha3Uncles": data(h.ommers_hash),
+            "miner": data(h.beneficiary),
+            "stateRoot": data(h.state_root),
+            "transactionsRoot": data(h.transactions_root),
+            "receiptsRoot": data(h.receipts_root),
+            "logsBloom": data(h.logs_bloom),
+            "difficulty": qty(h.difficulty),
+            "totalDifficulty": qty(
+                self.blockchain.get_total_difficulty(h.number) or 0
+            ),
+            "gasLimit": qty(h.gas_limit),
+            "gasUsed": qty(h.gas_used),
+            "timestamp": qty(h.unix_timestamp),
+            "extraData": data(h.extra_data),
+            "mixHash": data(h.mix_hash),
+            "nonce": data(h.nonce),
+            "size": qty(len(block.encode())),
+            "transactions": [
+                self._tx_json(tx, block, i) if full_txs else data(tx.hash)
+                for i, tx in enumerate(block.body.transactions)
+            ],
+            "uncles": [data(o.hash) for o in block.body.ommers],
+        }

@@ -1,0 +1,110 @@
+"""JSON-RPC 2.0 HTTP server over the stdlib threading HTTPServer.
+
+Parity: jsonrpc/http/JsonRpcHttpServer.scala:30 (akka-http POST + CORS)
++ JsonRpcController dispatch tables. Any public method of the
+registered services named like ``eth_...``/``net_...``/``web3_...``
+is callable; batch requests supported per the spec.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from khipu_tpu.jsonrpc.eth_service import EthService, RpcError
+
+_ALLOWED_PREFIXES = ("eth_", "net_", "web3_", "khipu_")
+
+
+class JsonRpcServer:
+    def __init__(self, service: EthService, host: str = "127.0.0.1",
+                 port: int = 8546):
+        self.service = service
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------- dispatch
+
+    def handle(self, request: Any) -> Any:
+        if isinstance(request, list):  # batch
+            return [self._handle_one(r) for r in request]
+        return self._handle_one(request)
+
+    def _handle_one(self, req: Any) -> Dict:
+        if not isinstance(req, dict):
+            return {
+                "jsonrpc": "2.0", "id": None,
+                "error": {"code": -32600, "message": "invalid request"},
+            }
+        rid = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params", []) or []
+        base = {"jsonrpc": "2.0", "id": rid}
+        if not any(method.startswith(p) for p in _ALLOWED_PREFIXES):
+            return {**base, "error": {"code": -32601, "message": f"method {method!r} not found"}}
+        fn = getattr(self.service, method, None)
+        if fn is None or not callable(fn):
+            return {**base, "error": {"code": -32601, "message": f"method {method!r} not found"}}
+        try:
+            return {**base, "result": fn(*params)}
+        except RpcError as e:
+            return {**base, "error": {"code": e.code, "message": str(e)}}
+        except TypeError as e:
+            return {**base, "error": {"code": -32602, "message": f"invalid params: {e}"}}
+        except Exception as e:  # internal error — never kill the server
+            return {**base, "error": {"code": -32603, "message": f"{type(e).__name__}: {e}"}}
+
+    # --------------------------------------------------------- server
+
+    def start(self) -> int:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    request = json.loads(body)
+                    response = outer.handle(request)
+                except json.JSONDecodeError:
+                    response = {
+                        "jsonrpc": "2.0", "id": None,
+                        "error": {"code": -32700, "message": "parse error"},
+                    }
+                payload = json.dumps(response).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_OPTIONS(self):  # CORS preflight
+                self.send_response(204)
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header(
+                    "Access-Control-Allow-Headers", "Content-Type"
+                )
+                self.send_header("Access-Control-Allow-Methods", "POST")
+                self.end_headers()
+
+            def log_message(self, *args):
+                pass  # quiet
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port  # resolves port=0
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -267,6 +267,7 @@ def execute_block(
     make_world: Callable[[bytes], BlockWorldState],
     khipu_config: KhipuConfig,
     validate: bool = True,
+    check_root: bool = True,
 ) -> BlockResult:
     """Execute every tx of a block and gate the result against the
     header (executeBlock:230 + validateBlockAfterExecution:603-620).
@@ -308,7 +309,7 @@ def execute_block(
     stats.exec_seconds = time.perf_counter() - t0
 
     if validate:
-        _validate_after(block, world, receipts, gas_used)
+        _validate_after(block, world, receipts, gas_used, check_root)
     return BlockResult(world, receipts, gas_used, stats)
 
 
@@ -448,9 +449,11 @@ def _pay_rewards(world: BlockWorldState, block: Block, khipu_config) -> None:
 
 def _validate_after(
     block: Block, world: BlockWorldState, receipts: List[Receipt],
-    gas_used: int,
+    gas_used: int, check_root: bool = True,
 ) -> None:
-    """The bit-exactness gate (Ledger.scala:603-620)."""
+    """The bit-exactness gate (Ledger.scala:603-620). ``check_root``
+    False defers the state-root comparison to the caller (window mode
+    checks all roots at finalize, after ONE batched device pass)."""
     from khipu_tpu.validators.roots import receipts_root
 
     header = block.header
@@ -459,12 +462,13 @@ def _validate_after(
             f"block {header.number}: gasUsed {gas_used} != header "
             f"{header.gas_used}"
         )
-    root = world.root_hash
-    if root != header.state_root:
-        raise ValidationAfterExecError(
-            f"block {header.number}: stateRoot {root.hex()} != header "
-            f"{header.state_root.hex()}"
-        )
+    if check_root:
+        root = world.root_hash
+        if root != header.state_root:
+            raise ValidationAfterExecError(
+                f"block {header.number}: stateRoot {root.hex()} != header "
+                f"{header.state_root.hex()}"
+            )
     rroot = receipts_root(receipts)
     if rroot != header.receipts_root:
         raise ValidationAfterExecError(

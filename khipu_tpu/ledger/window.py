@@ -1,0 +1,182 @@
+"""Block-window commit: N blocks of dirty trie nodes, one device pass.
+
+The north-star architecture (SURVEY §3.2 HOT LOOPs 3-4, BASELINE
+configs #1/#4): per-block state diffs accumulate into ONE deferred
+placeholder session; later blocks execute against the *unresolved*
+state (placeholder refs resolve through the session's staged store);
+``finalize`` hashes the whole window's node DAG level-synchronously —
+one batched device call per level across every block and every trie —
+then checks each block's resolved root against its header and persists.
+
+This is what amortizes device-dispatch latency over the window: a
+window of W blocks costs O(levels) device calls instead of
+O(W x levels), and each call carries W x the batch width.
+
+Pre-Byzantium receipts embed per-tx intermediate roots (host-computed
+during execution), so windows > 1 require Byzantium+ receipt semantics
+(ReplayDriver enforces this).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.domain.account import address_key
+from khipu_tpu.domain.block_header import BlockHeader
+from khipu_tpu.ledger.world import BlockWorldState
+from khipu_tpu.trie.bulk import Hasher, host_hasher
+from khipu_tpu.trie.deferred import DeferredMPT, finalize as finalize_deferred
+from khipu_tpu.trie.mpt import EMPTY_TRIE_HASH
+
+
+class _StagedReadThrough:
+    """Node source that serves the window's staged (unresolved) nodes
+    first, then the underlying storage — how a block reads state
+    committed by earlier blocks of the same open window."""
+
+    __slots__ = ("inner", "staged")
+
+    def __init__(self, inner, staged: Dict[bytes, bytes]):
+        self.inner = inner
+        self.staged = staged
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        v = self.staged.get(key)
+        if v is not None:
+            return v
+        return self.inner.get(key)
+
+
+class WindowMismatch(Exception):
+    def __init__(self, number: int, got: bytes, want: bytes):
+        super().__init__(
+            f"block {number}: window root {got.hex()} != header "
+            f"{want.hex()}"
+        )
+        self.number = number
+
+
+class WindowCommitter:
+    def __init__(self, storages, parent_root: bytes,
+                 hasher: Hasher = host_hasher,
+                 account_start_nonce: int = 0,
+                 get_block_hash=None):
+        self.storages = storages
+        self.hasher = hasher
+        self.account_start_nonce = account_start_nonce
+        self.get_block_hash = get_block_hash or (lambda n: None)
+
+        # ONE placeholder namespace for every trie in the window
+        self._logs: Dict[bytes, list] = {}
+        self._staged: Dict[bytes, bytes] = {}
+        self._counter = [0]
+        # only storage placeholders need tagging: finalize routes nodes
+        # account-side by default and storage-side on membership here
+        self._storage_phs: Set[bytes] = set()
+
+        self._storage_source = _StagedReadThrough(
+            storages.storage_node_storage, self._staged
+        )
+        self._evmcode_source = _StagedReadThrough(
+            storages.evmcode_storage, {}
+        )
+        self.account_trie = DeferredMPT(
+            _StagedReadThrough(storages.account_node_storage, self._staged),
+            root_hash=parent_root,
+            _logs=self._logs,
+            _staged=self._staged,
+            counter=self._counter,
+        )
+        # (header, root_ref) per committed block, checked at finalize
+        self._pending_blocks: List[Tuple[BlockHeader, bytes]] = []
+
+    # ------------------------------------------------------------ worlds
+
+    def make_world(self, state_root: bytes) -> BlockWorldState:
+        """World factory for execute_block. The root argument is the
+        previous block's (possibly placeholder) root — which is exactly
+        self.account_trie's current state; a mismatch means the caller
+        skipped a block."""
+        del state_root  # the open session IS the parent state
+        return BlockWorldState(
+            self.account_trie,
+            self._storage_source,
+            self._evmcode_source,
+            get_block_hash=self.get_block_hash,
+            account_start_nonce=self.account_start_nonce,
+        )
+
+    # ------------------------------------------------------------ commit
+
+    def commit_block(self, world: BlockWorldState, header: BlockHeader) -> None:
+        """Fold one executed block's world into the window session
+        (the deferred analog of world.flush)."""
+        final = world._materialized_accounts(hasher=None, window=self)
+        trie = self.account_trie
+        for addr in sorted(final):
+            acc = final[addr]
+            key = address_key(addr)
+            if acc is None:
+                trie = trie.remove(key)
+            else:
+                trie = trie.put(key, acc.encode())
+        self.account_trie = trie
+        for code in world.codes.values():
+            if code:
+                self._evmcode_source.staged[keccak256(code)] = code
+        self._pending_blocks.append(
+            (header, trie.force_hashed_root())
+        )
+
+    def storage_session(self, root_ref) -> DeferredMPT:
+        """A storage-trie session sharing the window namespace; root_ref
+        may be a placeholder from an earlier block of the window."""
+        if isinstance(root_ref, bytes) and (
+            root_ref == EMPTY_TRIE_HASH or not root_ref
+        ):
+            root_ref = b""
+        return DeferredMPT(
+            self._storage_source,
+            _root_ref=root_ref if root_ref else None,
+            root_hash=None if root_ref else EMPTY_TRIE_HASH,
+            _logs=self._logs,
+            _staged=self._staged,
+            counter=self._counter,
+            ref_sink=self._storage_phs,
+        )
+
+    # ---------------------------------------------------------- finalize
+
+    def finalize(self) -> List[Tuple[BlockHeader, bytes]]:
+        """Resolve the whole window's placeholder DAG (batched, level-
+        synchronous), CHECK every block root against its header, persist
+        all nodes + codes. Returns [(header, real_root)]."""
+        resolved_trie, mapping = finalize_deferred(
+            self.account_trie, self.hasher, return_mapping=True
+        )
+
+        results: List[Tuple[BlockHeader, bytes]] = []
+        for header, root_ref in self._pending_blocks:
+            real = mapping.get(root_ref, root_ref)
+            if real != header.state_root:
+                raise WindowMismatch(header.number, real, header.state_root)
+            results.append((header, real))
+
+        # route nodes to their stores by session tag
+        _, upserts = resolved_trie.changes()
+        account_nodes: Dict[bytes, bytes] = {}
+        storage_nodes: Dict[bytes, bytes] = {}
+        for ph, real in mapping.items():
+            enc = upserts.get(real)
+            if enc is None:
+                continue
+            if ph in self._storage_phs:
+                storage_nodes[real] = enc
+            else:
+                account_nodes[real] = enc
+        self.storages.account_node_storage.update([], account_nodes)
+        self.storages.storage_node_storage.update([], storage_nodes)
+        for code_hash, code in self._evmcode_source.staged.items():
+            self.storages.evmcode_storage.put(code_hash, code)
+        return results

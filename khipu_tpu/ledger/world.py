@@ -426,9 +426,14 @@ class BlockWorldState:
 
     # --------------------------------------------------- commit / root
 
-    def _materialized_accounts(self, hasher=None) -> Dict[bytes, Optional[Account]]:
+    def _materialized_accounts(
+        self, hasher=None, window=None
+    ) -> Dict[bytes, Optional[Account]]:
         """Resolve logs + deltas + dirty storages + codes into final
-        Account records per touched address."""
+        Account records per touched address. With ``window``, dirty
+        storage tries flush into the window's shared deferred session
+        and storage_root becomes a placeholder ref (resolved at window
+        finalize)."""
         out: Dict[bytes, Optional[Account]] = {}
         addresses = (
             set(self.accounts)
@@ -474,14 +479,29 @@ class BlockWorldState:
                 )
             ts = self.storages.get(addr)
             if ts is not None and ts.is_dirty():
-                new_trie = ts.flush_into(ts.trie, hasher)
-                acc = Account(
-                    nonce=acc.nonce,
-                    balance=acc.balance,
-                    storage_root=new_trie.root_hash,
-                    code_hash=acc.code_hash,
-                )
-                self._flushed_storage_tries[addr] = new_trie
+                if window is not None:
+                    session = window.storage_session(ts.trie._root_ref)
+                    upserts, removes = ts.dirty_pairs()
+                    for kb in removes:
+                        session = session.remove(kb)
+                    for kb, enc in upserts:
+                        session = session.put(kb, enc)
+                    root32 = session.force_hashed_root()
+                    acc = Account(
+                        nonce=acc.nonce,
+                        balance=acc.balance,
+                        storage_root=root32,
+                        code_hash=acc.code_hash,
+                    )
+                else:
+                    new_trie = ts.flush_into(ts.trie, hasher)
+                    acc = Account(
+                        nonce=acc.nonce,
+                        balance=acc.balance,
+                        storage_root=new_trie.root_hash,
+                        code_hash=acc.code_hash,
+                    )
+                    self._flushed_storage_tries[addr] = new_trie
             out[addr] = acc
         return out
 

@@ -49,12 +49,24 @@ class DeferredMPT(MerklePatriciaTrie):
     :meth:`commit`) to resolve."""
 
     def __init__(self, source, root_hash=None, _root_ref=None,
-                 _logs=None, _staged=None):
+                 _logs=None, _staged=None, counter=None, ref_sink=None):
         super().__init__(
             source, root_hash=root_hash, _root_ref=_root_ref,
             _logs=_logs, _staged=_staged,
         )
-        self._counter = [0]  # shared across _child() copies
+        # The base class defensively COPIES _logs/_staged; a deferred
+        # session must share them BY REFERENCE — the window commits
+        # several trie sessions into one placeholder namespace, and a
+        # read-through source resolves staged nodes across blocks.
+        if _logs is not None:
+            self._logs = _logs
+        if _staged is not None:
+            self._staged = _staged
+        # counter may be SHARED across sessions too; ref_sink tags which
+        # session created each placeholder so persist can route nodes to
+        # the right store
+        self._counter = counter if counter is not None else [0]
+        self._ref_sink = ref_sink
 
     def _child(self) -> "DeferredMPT":
         t = DeferredMPT(self.source)
@@ -62,6 +74,7 @@ class DeferredMPT(MerklePatriciaTrie):
         t._logs = self._logs
         t._staged = self._staged
         t._counter = self._counter
+        t._ref_sink = self._ref_sink
         return t
 
     def _ref(self, node):
@@ -74,6 +87,29 @@ class DeferredMPT(MerklePatriciaTrie):
         self._counter[0] += 1
         self._staged[ph] = encoded
         self._log_update(ph, encoded)
+        if self._ref_sink is not None:
+            self._ref_sink.add(ph)
+        return ph
+
+    def force_hashed_root(self) -> bytes:
+        """32-byte root ref: placeholders/real hashes pass through; an
+        inline (<32 B) root gets its own placeholder (the eager path
+        hashes inline roots too — mpt.persist parity). BLANK roots are
+        the empty-trie hash."""
+        from khipu_tpu.trie.mpt import EMPTY_TRIE_HASH
+
+        ref = self._root_ref
+        if ref == BLANK:
+            return EMPTY_TRIE_HASH
+        if isinstance(ref, bytes):
+            return ref
+        encoded = rlp_encode(ref)
+        ph = _make_placeholder(self._counter[0])
+        self._counter[0] += 1
+        self._staged[ph] = encoded
+        self._log_update(ph, encoded)
+        if self._ref_sink is not None:
+            self._ref_sink.add(ph)
         return ph
 
     def commit(self, hasher: Hasher = host_hasher) -> MerklePatriciaTrie:
@@ -82,10 +118,31 @@ class DeferredMPT(MerklePatriciaTrie):
         return finalize(self, hasher)
 
 
+def _substitute_bytes(value: bytes, mapping: Dict[bytes, bytes]) -> bytes:
+    """Replace EMBEDDED placeholders inside an opaque byte string (leaf
+    values may contain them: an account's RLP embeds its storage root,
+    which is a placeholder while the window is open)."""
+    pos = value.find(_PLACEHOLDER_PREFIX)
+    if pos < 0:
+        return value
+    out = bytearray(value)
+    while pos >= 0:
+        ph = bytes(out[pos : pos + 32])
+        real = mapping.get(ph)
+        if real is not None:
+            out[pos : pos + 32] = real
+        pos = bytes(out).find(_PLACEHOLDER_PREFIX, pos + 1)
+    return bytes(out)
+
+
 def _substitute(structure, mapping: Dict[bytes, bytes]):
-    """Replace placeholder refs inside a decoded node structure."""
+    """Replace placeholder refs (and embedded ones) inside a decoded
+    node structure."""
     if isinstance(structure, bytes):
-        return mapping.get(structure, structure)
+        direct = mapping.get(structure)
+        if direct is not None:
+            return direct
+        return _substitute_bytes(structure, mapping)
     return [_substitute(item, mapping) for item in structure]
 
 
@@ -93,16 +150,27 @@ def _collect_placeholders(structure, out: List[bytes]) -> None:
     if isinstance(structure, bytes):
         if _is_placeholder(structure):
             out.append(structure)
+        else:
+            pos = structure.find(_PLACEHOLDER_PREFIX)
+            while pos >= 0:
+                out.append(structure[pos : pos + 32])
+                pos = structure.find(_PLACEHOLDER_PREFIX, pos + 32)
         return
     for item in structure:
         _collect_placeholders(item, out)
 
 
-def finalize(trie: DeferredMPT, hasher: Hasher = host_hasher) -> MerklePatriciaTrie:
+def finalize(
+    trie: DeferredMPT,
+    hasher: Hasher = host_hasher,
+    return_mapping: bool = False,
+):
     """Hash the live placeholder DAG bottom-up, one batch per level.
 
     Dead placeholders (created then superseded within the same session;
     net refcount 0) were already dropped by the MPT's refcount log.
+    With ``return_mapping``, returns (trie, {placeholder: real_hash})
+    — the window committer resolves per-block root refs through it.
     """
     # live placeholders: positive log entries with placeholder keys
     live: Dict[bytes, bytes] = {}  # placeholder -> encoded (raw)
@@ -116,7 +184,17 @@ def finalize(trie: DeferredMPT, hasher: Hasher = host_hasher) -> MerklePatriciaT
         else:
             removed[h] = rec
 
-    structures = {ph: rlp_decode(enc) for ph, enc in live.items()}
+    # Resolve EVERY placeholder the session ever created (the staged
+    # store retains them): a window's intermediate block roots are
+    # superseded by later blocks (net refcount 0 — dead for PERSISTING)
+    # yet their resolved hashes are exactly what the per-block root
+    # checks compare against. Only live ones are written out below.
+    all_phs: Dict[bytes, bytes] = {
+        ph: enc
+        for ph, enc in trie._staged.items()
+        if _is_placeholder(ph)
+    }
+    structures = {ph: rlp_decode(enc) for ph, enc in all_phs.items()}
     deps: Dict[bytes, List[bytes]] = {}
     for ph, struct in structures.items():
         children: List[bytes] = []
@@ -171,9 +249,12 @@ def finalize(trie: DeferredMPT, hasher: Hasher = host_hasher) -> MerklePatriciaT
         root_ref = rlp_decode(
             rlp_encode(_substitute(root_ref, resolved))
         )
-    return MerklePatriciaTrie(
+    out = MerklePatriciaTrie(
         trie.source, _root_ref=root_ref, _logs=new_logs, _staged=new_staged
     )
+    if return_mapping:
+        return out, resolved
+    return out
 
 
 def batch_commit(

@@ -1,0 +1,82 @@
+"""Pending transaction pool.
+
+Parity: transactions/PendingTransactionsService.scala:66 — capacity-
+bounded (tx-pool-size = 1000) pending set keyed by tx hash; mined txs
+are removed as blocks are saved (RegularSyncService.scala:419); oldest
+entries evicted at capacity. Also the ommers pool counterpart
+(ommers/OmmersPool.scala:19, size 30).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, Optional
+
+from khipu_tpu.domain.block_header import BlockHeader
+from khipu_tpu.domain.transaction import SignedTransaction
+
+
+class PendingTransactionsPool:
+    def __init__(self, capacity: int = 1000):
+        self.capacity = capacity
+        # insertion order IS the eviction order (oldest first)
+        self._txs: "OrderedDict[bytes, SignedTransaction]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def add(self, stx: SignedTransaction) -> bool:
+        """Add a signature-valid tx; returns False for duplicates.
+        Oldest entries are evicted at capacity."""
+        if stx.sender is None:
+            raise ValueError("unrecoverable signature")
+        with self._lock:
+            if stx.hash in self._txs:
+                return False
+            while len(self._txs) >= self.capacity:
+                self._txs.popitem(last=False)
+            self._txs[stx.hash] = stx
+            return True
+
+    def get(self, tx_hash: bytes) -> Optional[SignedTransaction]:
+        with self._lock:
+            return self._txs.get(tx_hash)
+
+    def pending(self) -> List[SignedTransaction]:
+        with self._lock:
+            return list(self._txs.values())
+
+    def remove_mined(self, txs) -> int:
+        """Drop txs included in a saved block (:419)."""
+        removed = 0
+        with self._lock:
+            for stx in txs:
+                if self._txs.pop(stx.hash, None) is not None:
+                    removed += 1
+        return removed
+
+    def __len__(self) -> int:
+        return len(self._txs)
+
+
+class OmmersPool:
+    """Candidate ommer headers for mining (OmmersPool.scala:19)."""
+
+    def __init__(self, capacity: int = 30):
+        self.capacity = capacity
+        self._headers: "OrderedDict[bytes, BlockHeader]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def add(self, header: BlockHeader) -> None:
+        with self._lock:
+            self._headers[header.hash] = header
+            while len(self._headers) > self.capacity:
+                self._headers.popitem(last=False)
+
+    def candidates(self, for_number: int) -> List[BlockHeader]:
+        """Ommers must be within 6 generations of the including block."""
+        with self._lock:
+            return [
+                h
+                for h in self._headers.values()
+                if 0 < for_number - h.number <= 6
+            ]

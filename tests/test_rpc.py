@@ -1,0 +1,229 @@
+"""JSON-RPC + simulation + tx pool + keystore tests (parity targets
+jsonrpc/EthService.scala, Ledger.simulateTransaction:166-191,
+PendingTransactionsService.scala:66, keystore/KeyStore.scala:31)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.config import fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import (
+    Transaction,
+    contract_address,
+    sign_transaction,
+)
+from khipu_tpu.jsonrpc import EthService, JsonRpcServer
+from khipu_tpu.keystore import KeyStore, KeyStoreError, decrypt_key, encrypt_key
+from khipu_tpu.ledger.simulate import estimate_gas, simulate_call
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.txpool import PendingTransactionsPool
+
+CFG = fixture_config(chain_id=1)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(3)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ETH = 10**18
+
+RUNTIME = bytes.fromhex("60005460005260206000f3")
+_SS = bytes.fromhex("602a600055")
+_COPY = bytes(
+    [0x60, len(RUNTIME), 0x60, len(_SS) + 12, 0x60, 0, 0x39,
+     0x60, len(RUNTIME), 0x60, 0, 0xF3]
+)
+INIT = _SS + _COPY + RUNTIME
+
+
+@pytest.fixture(scope="module")
+def chain():
+    builder = ChainBuilder(
+        Blockchain(Storages(), CFG), CFG,
+        GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}),
+    )
+    builder.add_block(
+        [sign_transaction(
+            Transaction(0, 10**9, 300_000, None, 0, INIT), KEYS[0], chain_id=1
+        )],
+        coinbase=b"\xaa" * 20,
+    )
+    builder.add_block(
+        [sign_transaction(
+            Transaction(1, 10**9, 21_000, ADDRS[1], 5 * ETH), KEYS[0], chain_id=1
+        )],
+        coinbase=b"\xaa" * 20,
+    )
+    return builder.blockchain
+
+
+@pytest.fixture(scope="module")
+def service(chain):
+    return EthService(chain, CFG)
+
+
+class TestSimulate:
+    def test_eth_call_reads_contract(self, chain):
+        caddr = contract_address(ADDRS[0], 0)
+        header = chain.get_header_by_number(2)
+        r = simulate_call(
+            chain.get_world_state, header, CFG, to=caddr, gas=100_000
+        )
+        assert r.ok
+        assert int.from_bytes(r.output, "big") == 42
+
+    def test_simulation_discards_writes(self, chain):
+        header = chain.get_header_by_number(2)
+        before = chain.get_account(ADDRS[1], header.state_root).balance
+        simulate_call(
+            chain.get_world_state, header, CFG,
+            sender=ADDRS[0], to=ADDRS[1], value=ETH, gas=30_000,
+        )
+        assert chain.get_account(ADDRS[1], header.state_root).balance == before
+
+    def test_estimate_gas_transfer(self, chain):
+        header = chain.get_header_by_number(2)
+        est = estimate_gas(
+            chain.get_world_state, header, CFG,
+            sender=ADDRS[0], to=ADDRS[1], value=1,
+        )
+        assert est == 21_000
+
+    def test_estimate_gas_contract_call(self, chain):
+        caddr = contract_address(ADDRS[0], 0)
+        header = chain.get_header_by_number(2)
+        est = estimate_gas(
+            chain.get_world_state, header, CFG, to=caddr
+        )
+        assert est > 21_000
+        # the estimate is minimal-sufficient: one less unit fails
+        r_ok = simulate_call(
+            chain.get_world_state, header, CFG, to=caddr, gas=est
+        )
+        r_low = simulate_call(
+            chain.get_world_state, header, CFG, to=caddr, gas=est - 1
+        )
+        assert r_ok.ok and not r_low.ok
+
+
+class TestEthService:
+    def test_basic_queries(self, service):
+        assert service.eth_blockNumber() == "0x2"
+        assert service.eth_chainId() == "0x1"
+        bal = service.eth_getBalance("0x" + ADDRS[1].hex())
+        assert int(bal, 16) == 1005 * ETH
+        assert service.eth_getTransactionCount("0x" + ADDRS[0].hex()) == "0x2"
+        assert service.net_version() == "1"
+        assert service.web3_sha3("0x") == "0x" + keccak256(b"").hex()
+
+    def test_block_and_tx_queries(self, service, chain):
+        block = service.eth_getBlockByNumber("latest", True)
+        assert block["number"] == "0x2"
+        assert len(block["transactions"]) == 1
+        tx_hash = block["transactions"][0]["hash"]
+        tx = service.eth_getTransactionByHash(tx_hash)
+        assert tx["blockNumber"] == "0x2"
+        receipt = service.eth_getTransactionReceipt(tx_hash)
+        assert receipt["status"] == "0x1"
+        assert receipt["gasUsed"] == hex(21_000)
+        by_hash = service.eth_getBlockByHash(block["hash"])
+        assert by_hash["number"] == "0x2"
+
+    def test_code_and_storage(self, service):
+        caddr = "0x" + contract_address(ADDRS[0], 0).hex()
+        assert service.eth_getCode(caddr) == "0x" + RUNTIME.hex()
+        slot0 = service.eth_getStorageAt(caddr, "0x0")
+        assert int(slot0, 16) == 42
+
+    def test_eth_call_and_estimate(self, service):
+        caddr = "0x" + contract_address(ADDRS[0], 0).hex()
+        out = service.eth_call({"to": caddr})
+        assert int(out, 16) == 42
+        est = service.eth_estimateGas(
+            {"from": "0x" + ADDRS[0].hex(), "to": "0x" + ADDRS[1].hex(),
+             "value": "0x1"}
+        )
+        assert est == hex(21_000)
+
+    def test_send_raw_transaction(self, service):
+        stx = sign_transaction(
+            Transaction(2, 10**9, 21_000, ADDRS[2], 7), KEYS[0], chain_id=1
+        )
+        h = service.eth_sendRawTransaction("0x" + stx.encode().hex())
+        assert h == "0x" + stx.hash.hex()
+        assert len(service.eth_pendingTransactions()) == 1
+        found = service.eth_getTransactionByHash(h)
+        assert found["blockNumber"] is None  # pending
+
+
+class TestHttpServer:
+    def test_end_to_end_http(self, service):
+        server = JsonRpcServer(service, port=0)
+        port = server.start()
+        try:
+            def rpc(method, params=None, rid=1):
+                body = json.dumps(
+                    {"jsonrpc": "2.0", "id": rid, "method": method,
+                     "params": params or []}
+                ).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    return json.loads(resp.read())
+
+            out = rpc("eth_blockNumber")
+            assert out["result"] == "0x2"
+            out = rpc("eth_getBalance", ["0x" + ADDRS[1].hex(), "latest"])
+            assert int(out["result"], 16) == 1005 * ETH
+            out = rpc("rude_method")
+            assert out["error"]["code"] == -32601
+            out = rpc("eth_getBalance", ["nonsense"])
+            assert "error" in out
+        finally:
+            server.stop()
+
+
+class TestTxPool:
+    def test_capacity_and_remove_mined(self):
+        pool = PendingTransactionsPool(capacity=3)
+        txs = [
+            sign_transaction(
+                Transaction(n, 1, 21000, ADDRS[1], n), KEYS[0], chain_id=1
+            )
+            for n in range(5)
+        ]
+        for t in txs:
+            pool.add(t)
+        assert len(pool) == 3  # oldest two evicted
+        assert pool.get(txs[0].hash) is None
+        assert not pool.add(txs[4])  # duplicate
+        removed = pool.remove_mined([txs[3], txs[4]])
+        assert removed == 2 and len(pool) == 1
+
+
+class TestKeyStore:
+    def test_encrypt_decrypt_roundtrip(self):
+        priv = (7).to_bytes(32, "big")
+        keyfile = encrypt_key(priv, "hunter2", scrypt_n=1 << 12)
+        wallet = decrypt_key(keyfile, "hunter2")
+        assert wallet.private_key == priv
+        assert wallet.address == pubkey_to_address(privkey_to_pubkey(priv))
+        with pytest.raises(KeyStoreError, match="MAC"):
+            decrypt_key(keyfile, "wrong")
+
+    def test_keystore_directory(self, tmp_path):
+        ks = KeyStore(str(tmp_path))
+        addr = ks.new_account("pw")
+        assert ks.list_accounts() == [addr]
+        wallet = ks.unlock(addr, "pw")
+        assert wallet.address == addr
+        with pytest.raises(KeyStoreError):
+            ks.unlock(addr, "nope")
+        with pytest.raises(KeyStoreError):
+            ks.unlock(b"\x01" * 20, "pw")

@@ -1,0 +1,159 @@
+"""Block-window commit tests (ledger/window.py): N blocks, one batched
+level-synchronous resolve, per-block root checks — the north-star
+commit pipeline (BASELINE configs #1/#4)."""
+
+import dataclasses
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.config import SyncConfig, fixture_config
+from khipu_tpu.domain.block import Block
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import (
+    Transaction,
+    contract_address,
+    sign_transaction,
+)
+from khipu_tpu.ledger.window import WindowMismatch
+from khipu_tpu.storage.compactor import verify_reachable
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.sync.replay import ReplayDriver
+
+CFG = fixture_config(chain_id=1)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(4)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ETH = 10**18
+MINER = b"\xaa" * 20
+
+RUNTIME = bytes.fromhex("60005460005260206000f3")
+_SS = bytes.fromhex("602a600055")
+_COPY = bytes(
+    [0x60, len(RUNTIME), 0x60, len(_SS) + 12, 0x60, 0, 0x39,
+     0x60, len(RUNTIME), 0x60, 0, 0xF3]
+)
+INIT = _SS + _COPY + RUNTIME
+
+
+def tx(i, nonce, to, value, gas=21000, payload=b""):
+    return sign_transaction(
+        Transaction(nonce, 10**9, gas, to, value, payload),
+        KEYS[i], chain_id=1,
+    )
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """5 blocks: deploy, cross-block call, second deploy + transfers."""
+    builder = ChainBuilder(
+        Blockchain(Storages(), CFG), CFG,
+        GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}),
+    )
+    blocks = [
+        builder.add_block(
+            [tx(0, 0, None, 0, gas=300_000, payload=INIT)], coinbase=MINER
+        )
+    ]
+    caddr = contract_address(ADDRS[0], 0)
+    blocks.append(
+        builder.add_block(
+            [tx(0, 1, caddr, 0, gas=100_000), tx(1, 0, ADDRS[2], 123)],
+            coinbase=MINER,
+        )
+    )
+    blocks.append(
+        builder.add_block(
+            [tx(0, 2, None, 1000, gas=300_000, payload=INIT),
+             tx(1, 1, ADDRS[3], 7)],
+            coinbase=MINER,
+        )
+    )
+    blocks.append(builder.add_block([tx(2, 0, ADDRS[0], 1)], coinbase=MINER))
+    blocks.append(builder.add_block([tx(2, 1, ADDRS[0], 1)], coinbase=MINER))
+    return blocks, caddr
+
+
+def window_cfg(w, parallel=True):
+    return dataclasses.replace(
+        CFG, sync=SyncConfig(parallel_tx=parallel, commit_window_blocks=w)
+    )
+
+
+class TestWindowedReplay:
+    @pytest.mark.parametrize("window", [2, 3, 5, 8])
+    def test_windowed_equals_per_block(self, chain, window):
+        """Any window size produces the identical chain state as the
+        eager per-block path — and the persisted stores are complete
+        (no node stranded in the staged dicts)."""
+        blocks, caddr = chain
+        cfg = window_cfg(window)
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
+        stats = ReplayDriver(bc, cfg).replay(blocks)
+        assert stats.blocks == 5
+        head = blocks[-1].header
+        assert bc.get_header_by_number(5).hash == blocks[-1].hash
+        # persisted-store-only reads (no window session alive)
+        fresh = Blockchain(bc.storages, cfg)
+        world = fresh.get_world_state(head.state_root)
+        assert world.get_storage(caddr, 0) == 42
+        assert world.get_code(caddr) == RUNTIME
+        report = verify_reachable(
+            bc.storages.account_node_storage,
+            bc.storages.storage_node_storage,
+            bc.storages.evmcode_storage,
+            head.state_root,
+        )
+        assert report.missing == 0
+
+    def test_cross_block_reads_inside_window(self, chain):
+        """Block 2 calls the contract block 1 deployed, with both inside
+        ONE open window — the staged read-through is load-bearing."""
+        blocks, _ = chain
+        cfg = window_cfg(5, parallel=False)
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
+        ReplayDriver(bc, cfg).replay(blocks)  # single 5-block window
+        assert bc.get_header_by_number(5).hash == blocks[-1].hash
+
+    def test_mismatch_pinpoints_block(self, chain):
+        blocks, _ = chain
+        cfg = window_cfg(4)
+        bad = Block(
+            dataclasses.replace(blocks[2].header, state_root=b"\x13" * 32),
+            blocks[2].body,
+        )
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
+        with pytest.raises(WindowMismatch) as e:
+            ReplayDriver(bc, cfg, validate_headers=False).replay(
+                [blocks[0], blocks[1], bad]
+            )
+        assert e.value.number == 3
+
+    def test_pre_byzantium_window_rejected(self, chain):
+        blocks, _ = chain
+        cfg = dataclasses.replace(
+            fixture_config(chain_id=1, byzantium_block=10**9),
+            sync=SyncConfig(commit_window_blocks=4),
+        )
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
+        with pytest.raises(ValueError, match="Byzantium"):
+            ReplayDriver(bc, cfg, validate_headers=False).replay(blocks[:2])
+
+    def test_balance_accounting_through_windows(self, chain):
+        blocks, _ = chain
+        cfg = window_cfg(3)
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
+        ReplayDriver(bc, cfg).replay(blocks)
+        root = blocks[-1].header.state_root
+        # ADDRS[2]: +123 (block 2), then sent 1 wei twice with fees
+        acc = bc.get_account(ADDRS[2], root)
+        assert acc.balance == 1000 * ETH + 123 - 2 * (21000 * 10**9 + 1)
+        assert acc.nonce == 2
