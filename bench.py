@@ -233,7 +233,14 @@ def bench_snapshot_verify(N=1 << 20, L=576):
 
 
 def bench_keccak_primary():
-    """Config #2 (primary): 1M x 576B batched Keccak on one chip."""
+    """Config #2 (primary): batched Keccak on one chip, steady state.
+
+    8 rounds of 1M x 576B hashes run inside ONE dispatch (each round's
+    input derived from a fresh salt, digests xor-accumulated so every
+    hash is live) — amortizing the per-dispatch round-trip the axon
+    tunnel charges (~91 ms, docs/roofline.md), which is not part of the
+    kernel's real throughput on directly-attached hardware.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -241,33 +248,49 @@ def bench_keccak_primary():
     from khipu_tpu.base.crypto.keccak import keccak256
     from khipu_tpu.ops.keccak_pallas import _build_device_fixed
 
-    N, L = 1 << 20, 576
+    N, L, ROUNDS = 1 << 20, 576, 8
     run = _build_device_fixed(L, False)
     base = jax.random.bits(jax.random.PRNGKey(2026), (N, L // 4), jnp.uint32)
 
     @jax.jit
-    def step(words, salt):
+    def one(words, salt):
         data = jax.lax.bitcast_convert_type(words ^ salt, jnp.uint8).reshape(N, L)
         return data, run(data)
 
     # correctness gate: a wrong kernel benches at zero
-    data0, digests = jax.block_until_ready(step(base, jnp.uint32(0)))
+    data0, digests = one(base, jnp.uint32(0))
     rows = np.asarray(jax.device_get(data0[:4]))
     outs = np.asarray(jax.device_get(digests[:4]))
     for i in range(4):
         assert outs[i].tobytes() == keccak256(rows[i].tobytes()), "kernel mismatch"
 
+    @jax.jit
+    def step(words, salt0):
+        def body(i, carry):
+            acc, salt = carry
+            data = jax.lax.bitcast_convert_type(
+                words ^ salt, jnp.uint8
+            ).reshape(N, L)
+            return acc ^ run(data), salt + jnp.uint32(1)
+        acc, _ = jax.lax.fori_loop(
+            0, ROUNDS, body, (jnp.zeros((N, 32), jnp.uint8), salt0)
+        )
+        return acc
+
+    np.asarray(jax.device_get(step(base, jnp.uint32(0))[:1]))  # warm
     times = []
-    for i in range(1, 9):
+    for i in range(1, 6):
         t0 = time.perf_counter()
-        jax.block_until_ready(step(base, jnp.uint32(i))[1])
+        np.asarray(jax.device_get(step(base, jnp.uint32(i * ROUNDS))[:1]))
         times.append(time.perf_counter() - t0)
     dt = sorted(times)[len(times) // 2]
+    rate = ROUNDS * N / dt
     emit(
         "keccak256_576B_trie_node_hashes_per_sec_per_chip",
-        round(N / dt),
+        round(rate),
         "hashes/s/chip",
-        vs_baseline=round((N / dt) / cpu_scalar_baseline(L), 2),
+        vs_baseline=round(rate / cpu_scalar_baseline(L), 2),
+        hashes_per_dispatch=ROUNDS * N,
     )
 
 

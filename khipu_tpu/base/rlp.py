@@ -124,6 +124,13 @@ def rlp_decode(data: bytes) -> Any:
     return item
 
 
+def rlp_decode_first(data: bytes):
+    """Decode the first RLP item, tolerating trailing bytes — EIP-8
+    handshake bodies append random padding after the list. Returns
+    (item, bytes_consumed)."""
+    return _decode_at(data, 0)
+
+
 def rlp_encode_int(value: int) -> bytes:
     """Encode a non-negative scalar (minimal big-endian, 0 -> empty string)."""
     if value < 0:

@@ -1,0 +1,163 @@
+"""gRPC bridge: block batches in, verified state roots back.
+
+Parity: SURVEY §2.9 north-star channel — "Akka regular-sync actors
+stream block batches to the TPU host over a thin gRPC bridge". The
+service is schema-light by design (raw-bytes methods, RLP payloads) so
+the JVM side needs no shared protobuf artifacts — any gRPC client can
+call ``khipu.Bridge/ExecuteBlocks`` with an RLP list of block RLPs and
+read back rlp([[number, state_root], ...]).
+
+Methods (all request/response = opaque bytes):
+  ExecuteBlocks: rlp([block_rlp, ...]) -> rlp([[number_be, root], ...])
+                 — executes + persists through the window committer
+                 (device-batched trie commits), all roots gated.
+  BestBlock:     b"" -> rlp([number_be, hash])
+  GetStateRoot:  rlp(number_be) -> root (32 bytes) | b"" if unknown
+  Ping:          x -> x
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import List, Optional
+
+import grpc
+
+from khipu_tpu.base.rlp import rlp_decode, rlp_encode
+from khipu_tpu.config import KhipuConfig
+from khipu_tpu.domain.block import Block
+from khipu_tpu.domain.blockchain import Blockchain
+from khipu_tpu.evm.dataword import from_bytes, to_minimal_bytes
+
+SERVICE = "khipu.Bridge"
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class BridgeServer:
+    def __init__(self, blockchain: Blockchain, config: KhipuConfig,
+                 device_commit: bool = False, max_workers: int = 4):
+        self.blockchain = blockchain
+        self.config = config
+        self.device_commit = device_commit
+        self.max_workers = max_workers
+        self._exec_lock = threading.Lock()  # blocks apply serially
+        self._server: Optional[grpc.Server] = None
+
+    # ------------------------------------------------------------ methods
+
+    def _execute_blocks(self, request: bytes, context) -> bytes:
+        from khipu_tpu.sync.replay import ReplayDriver
+
+        try:
+            items = rlp_decode(request)
+            blocks = [Block.decode(rlp_encode(item)) for item in items]
+        except Exception as e:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, f"bad batch: {e}"
+            )
+        with self._exec_lock:
+            driver = ReplayDriver(
+                self.blockchain, self.config,
+                device_commit=self.device_commit,
+            )
+            try:
+                driver.replay(blocks)
+            except Exception as e:
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"{type(e).__name__}: {e}",
+                )
+        out = [
+            [to_minimal_bytes(b.number), b.header.state_root]
+            for b in blocks
+        ]
+        return rlp_encode(out)
+
+    def _best_block(self, request: bytes, context) -> bytes:
+        n = self.blockchain.best_block_number
+        header = self.blockchain.get_header_by_number(n)
+        return rlp_encode(
+            [to_minimal_bytes(n), header.hash if header else b""]
+        )
+
+    def _get_state_root(self, request: bytes, context) -> bytes:
+        n = from_bytes(rlp_decode(request))
+        header = self.blockchain.get_header_by_number(n)
+        return header.state_root if header else b""
+
+    def _ping(self, request: bytes, context) -> bytes:
+        return request
+
+    # ------------------------------------------------------------- server
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        handlers = {
+            "ExecuteBlocks": grpc.unary_unary_rpc_method_handler(
+                self._execute_blocks, _identity, _identity
+            ),
+            "BestBlock": grpc.unary_unary_rpc_method_handler(
+                self._best_block, _identity, _identity
+            ),
+            "GetStateRoot": grpc.unary_unary_rpc_method_handler(
+                self._get_state_root, _identity, _identity
+            ),
+            "Ping": grpc.unary_unary_rpc_method_handler(
+                self._ping, _identity, _identity
+            ),
+        }
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self.max_workers)
+        )
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        bound = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+        return bound
+
+    def stop(self, grace: float = 0.5) -> None:
+        if self._server is not None:
+            self._server.stop(grace)
+            self._server = None
+
+
+class BridgeClient:
+    """The JVM-side caller's shape, for tests and local tooling."""
+
+    def __init__(self, target: str):
+        self.channel = grpc.insecure_channel(target)
+
+    def _call(self, method: str, payload: bytes) -> bytes:
+        fn = self.channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+        return fn(payload)
+
+    def execute_blocks(self, blocks: List[Block]):
+        payload = rlp_encode(
+            [rlp_decode(b.encode()) for b in blocks]
+        )
+        out = rlp_decode(self._call("ExecuteBlocks", payload))
+        return [(from_bytes(n), root) for n, root in out]
+
+    def best_block(self):
+        n, h = rlp_decode(self._call("BestBlock", b""))
+        return from_bytes(n), h
+
+    def get_state_root(self, number: int) -> Optional[bytes]:
+        out = self._call(
+            "GetStateRoot", rlp_encode(to_minimal_bytes(number))
+        )
+        return out if out else None
+
+    def ping(self, payload: bytes = b"ping") -> bytes:
+        return self._call("Ping", payload)
+
+    def close(self) -> None:
+        self.channel.close()

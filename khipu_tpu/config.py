@@ -83,6 +83,9 @@ class SyncConfig:
     parallel_tx: bool = True  # optimistic parallel execution (P1)
     tx_workers: int = 8  # worker pool width (TxProcessor.scala:29 role)
     commit_window_blocks: int = 1  # blocks batched per TPU trie commit
+    # opcode-level trace for ONE block number (debug-trace-at;
+    # VM.scala:40-57) — that block runs sequentially with a per-op line
+    debug_trace_at: Optional[int] = None
 
 
 @dataclass(frozen=True)

@@ -35,6 +35,16 @@ from khipu_tpu.evm.stack import Stack, StackError
 
 MAX_CALL_DEPTH = 1024
 
+# Opcode-level trace hook (debug-trace-at, VM.scala:40-57): set by the
+# ledger around a traced block (which runs sequentially, so a module
+# global is race-free); receives (depth, pc, op, gas, stack_items).
+_TRACE: Optional[Callable] = None
+
+
+def set_trace(fn: Optional[Callable]) -> None:
+    global _TRACE
+    _TRACE = fn
+
 
 # ----------------------------------------------------------------- errors
 
@@ -1009,6 +1019,8 @@ def run(
             fn = table[op]
             if fn is None:
                 raise InvalidOpcode(f"0x{op:02x}")
+            if _TRACE is not None:
+                _TRACE(env.depth, st.pc, op, st.gas, st.stack.items)
             fn(st)
     except StackError as e:
         return ProgramResult(0, world, error=f"Stack:{e}")

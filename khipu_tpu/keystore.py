@@ -139,7 +139,11 @@ class KeyStore:
     def import_key(self, priv: bytes, passphrase: str) -> bytes:
         keyfile = encrypt_key(priv, passphrase)
         address = bytes.fromhex(keyfile["address"])
-        with open(self._path(address), "w") as f:
+        path = self._path(address)
+        # 0600 like geth/the reference: the scrypt-encrypted key must
+        # not be readable by other local users
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             json.dump(keyfile, f)
         return address
 

@@ -293,16 +293,36 @@ def execute_block(
     t0 = time.perf_counter()
     stats = Stats(tx_count=len(txs))
 
-    if khipu_config.sync.parallel_tx and len(txs) > 1:
-        world, receipts, gas_used = _execute_parallel(
-            config, block_env, txs, senders, parent_state_root,
-            make_world, header, khipu_config.sync.tx_workers, stats,
-        )
-    else:
-        world, receipts, gas_used = _execute_sequential(
-            config, block_env, txs, senders, parent_state_root,
-            make_world, header,
-        )
+    traced = khipu_config.sync.debug_trace_at == header.number
+    if traced:
+        # debug-trace-at disables parallelism for that block
+        # (Ledger.executeBlock:232) and prints one line per opcode
+        from khipu_tpu.evm.vm import set_trace
+
+        def _trace(depth, pc, op, gas, stack):
+            top = hex(stack[-1]) if stack else "-"
+            print(
+                f"[trace] 0x{op:02x} | pc {pc} | depth {depth} | "
+                f"gas {gas} | stack[{len(stack)}] top {top}"
+            )
+
+        set_trace(_trace)
+    try:
+        if khipu_config.sync.parallel_tx and len(txs) > 1 and not traced:
+            world, receipts, gas_used = _execute_parallel(
+                config, block_env, txs, senders, parent_state_root,
+                make_world, header, khipu_config.sync.tx_workers, stats,
+            )
+        else:
+            world, receipts, gas_used = _execute_sequential(
+                config, block_env, txs, senders, parent_state_root,
+                make_world, header,
+            )
+    finally:
+        if traced:
+            from khipu_tpu.evm.vm import set_trace
+
+            set_trace(None)
 
     _pay_rewards(world, block, khipu_config)
     stats.gas_used = gas_used

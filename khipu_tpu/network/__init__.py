@@ -1,0 +1,2 @@
+"""P2P network stack (khipu-eth/.../network/ role): ECIES, RLPx
+handshake + framing, devp2p/eth wire messages, peers, discovery."""

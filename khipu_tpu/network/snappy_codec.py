@@ -1,0 +1,95 @@
+"""Minimal Snappy block-format codec (devp2p p2p/v5 frame compression).
+
+The reference pulls in snappy-java (SURVEY §2.10); this environment has
+no snappy binding, so: a full DEcompressor (literals + all copy tags),
+and a compressor that emits pure literals — which is valid Snappy (any
+decoder accepts it; the format mandates no minimum compression).
+"""
+
+from __future__ import annotations
+
+
+class SnappyError(Exception):
+    pass
+
+
+def _read_varint(data: bytes, pos: int):
+    out = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise SnappyError("truncated varint")
+        b = data[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+        if shift > 35:
+            raise SnappyError("varint overflow")
+
+
+def _write_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def compress(data: bytes) -> bytes:
+    """All-literal encoding: varint(len) + 60-byte literal chunks."""
+    out = bytearray(_write_varint(len(data)))
+    for pos in range(0, len(data), 60):
+        chunk = data[pos : pos + 60]
+        out.append((len(chunk) - 1) << 2)  # literal tag, inline length
+        out.extend(chunk)
+    return bytes(out)
+
+
+def decompress(data: bytes, max_len: int = 1 << 24) -> bytes:
+    total, pos = _read_varint(data, 0)
+    if total > max_len:
+        raise SnappyError(f"declared length {total} > cap {max_len}")
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            n = (tag >> 2) + 1
+            if n > 60:
+                extra = n - 60
+                if extra > 4:
+                    raise SnappyError("bad literal length")
+                n = int.from_bytes(data[pos : pos + extra], "little") + 1
+                pos += extra
+            out += data[pos : pos + n]
+            pos += n
+        else:  # copy
+            if kind == 1:
+                n = ((tag >> 2) & 0x7) + 4
+                offset = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif kind == 2:
+                n = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos : pos + 2], "little")
+                pos += 2
+            else:
+                n = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos : pos + 4], "little")
+                pos += 4
+            if offset == 0 or offset > len(out):
+                raise SnappyError("bad copy offset")
+            start = len(out) - offset
+            for i in range(n):  # may overlap: byte-at-a-time
+                out.append(out[start + i])
+        if len(out) > max_len:
+            raise SnappyError("output exceeds cap")
+    if len(out) != total:
+        raise SnappyError(f"length mismatch {len(out)} != {total}")
+    return bytes(out)

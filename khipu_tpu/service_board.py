@@ -1,0 +1,125 @@
+"""ServiceBoard: the composition root wiring every subsystem from one
+config, plus coordinated shutdown.
+
+Parity: service/ServiceBoard.scala:64 (engine select :99-138, Blockchain
+:141, Ledger wiring :154, PeerManager :172, EthService :193; node key
+load/generate :217-242) and Khipu.scala:45 (main :56-88, coordinated
+storage close :58-66). ``python -m khipu_tpu`` boots it.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+from typing import Optional
+
+from khipu_tpu.config import KhipuConfig
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.txpool import OmmersPool, PendingTransactionsPool
+
+
+class ServiceBoard:
+    def __init__(self, config: KhipuConfig,
+                 genesis: Optional[GenesisSpec] = None):
+        self.config = config
+        self.storages = Storages(
+            engine=config.db.engine,
+            data_dir=config.db.data_dir,
+            unconfirmed_depth=config.db.unconfirmed_depth,
+            cache_size=config.db.cache_size,
+        )
+        self.blockchain = Blockchain(self.storages, config)
+        if self.blockchain.get_header_by_number(0) is None:
+            self.blockchain.load_genesis(genesis or GenesisSpec())
+        self.tx_pool = PendingTransactionsPool()
+        self.ommers_pool = OmmersPool()
+        self.node_key = self._load_or_create_node_key()
+        self._rpc_server = None
+        self._bridge_server = None
+        self._peer_manager = None
+        self._discovery = None
+
+    # ---------------------------------------------------------- node key
+
+    def _load_or_create_node_key(self) -> bytes:
+        """nodeKey load/generate (ServiceBoard.scala:217-242)."""
+        data_dir = self.config.db.data_dir
+        if data_dir is None:
+            return secrets.token_bytes(32)
+        path = os.path.join(data_dir, "nodekey")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return f.read(32)
+        os.makedirs(data_dir, exist_ok=True)
+        key = secrets.token_bytes(32)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(key)
+        return key
+
+    # ---------------------------------------------------------- services
+
+    def start_rpc(self, host: str = "127.0.0.1", port: int = 8546) -> int:
+        from khipu_tpu.jsonrpc import EthService, JsonRpcServer
+
+        service = EthService(self.blockchain, self.config, self.tx_pool)
+        self._rpc_server = JsonRpcServer(service, host, port)
+        return self._rpc_server.start()
+
+    def start_bridge(self, host: str = "127.0.0.1", port: int = 50051,
+                     device_commit: bool = False) -> int:
+        from khipu_tpu.bridge import BridgeServer
+
+        self._bridge_server = BridgeServer(
+            self.blockchain, self.config, device_commit=device_commit
+        )
+        return self._bridge_server.start(host, port)
+
+    def start_network(self, host: str = "127.0.0.1", port: int = 30303) -> int:
+        from khipu_tpu.network.host_service import HostService
+        from khipu_tpu.network.messages import Status
+        from khipu_tpu.network.peer import PeerManager
+
+        def status_factory() -> Status:
+            best = self.blockchain.best_block_number
+            header = self.blockchain.get_header_by_number(best)
+            genesis = self.blockchain.get_header_by_number(0)
+            return Status(
+                63,
+                self.config.blockchain.chain_id,
+                self.blockchain.get_total_difficulty(best) or 0,
+                header.hash,
+                genesis.hash,
+            )
+
+        self._peer_manager = PeerManager(
+            self.node_key, "khipu-tpu", status_factory
+        )
+        HostService(self.blockchain).install(self._peer_manager)
+        return self._peer_manager.listen(host, port)
+
+    def start_discovery(self, host: str = "127.0.0.1", port: int = 30303) -> int:
+        from khipu_tpu.network.discovery import DiscoveryService
+
+        self._discovery = DiscoveryService(self.node_key, host, port)
+        self._discovery.start()
+        return self._discovery.port
+
+    @property
+    def peer_manager(self):
+        return self._peer_manager
+
+    # ---------------------------------------------------------- shutdown
+
+    def shutdown(self) -> None:
+        """CoordinatedShutdown (Khipu.scala:58-66): services first,
+        storages flushed+closed last."""
+        for svc in (self._rpc_server, self._bridge_server,
+                    self._peer_manager, self._discovery):
+            if svc is not None:
+                try:
+                    svc.stop()
+                except Exception:
+                    pass
+        self.storages.stop()

@@ -1,0 +1,50 @@
+"""Remote read-through node storage: the self-healing missing-node path.
+
+Parity: storage/DistributedNodeStorage.scala:13-57 (read-through the
+cluster-sharded NodeEntity cache) and the MPTNodeMissingException
+recovery loop (SURVEY §5.3: Ledger.scala:69,511,542 +
+RegularSyncService.scala:336-345 — fetch that exact node from a healthy
+peer, store it, resume). The fetch callback is a peer pool's
+GetNodeData in production, the gRPC bridge or another store in tests;
+fetched values are content-address verified before being admitted.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Mapping, Optional
+
+from khipu_tpu.base.crypto.keccak import keccak256
+
+
+class RemoteReadThroughNodeStorage:
+    """Wraps a NodeStorage; on local miss, fetches by hash, verifies
+    kec256(value) == hash, persists locally, serves the read."""
+
+    def __init__(self, inner,
+                 fetch: Callable[[List[bytes]], Mapping[bytes, bytes]]):
+        self.inner = inner
+        self.fetch = fetch
+        self.healed = 0  # nodes recovered from remote
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        v = self.inner.get(key)
+        if v is not None:
+            return v
+        got = self.fetch([key])
+        v = got.get(key)
+        if v is None:
+            return None
+        if keccak256(v) != key:
+            return None  # corrupt response: do not admit
+        self.inner.put(key, v)
+        self.healed += 1
+        return v
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.inner.put(key, value)
+
+    def update(self, to_remove, to_upsert) -> None:
+        self.inner.update(to_remove, to_upsert)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

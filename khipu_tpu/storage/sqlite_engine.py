@@ -1,0 +1,149 @@
+"""SQLite-backed storage engine — the LMDB/RocksDB-role alternative.
+
+Parity: khipu-lmdb / khipu-rocksdb (SURVEY §2.4): a second persistent
+engine behind the same DataSource SPI, selected purely by
+``db.engine = "sqlite"``. One database file per topic directory; WAL
+mode for concurrent readers. The native append-log engine remains the
+Kesque-role primary; this is the embedded-KV alternative the reference
+keeps for operational flexibility.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Iterable, Mapping, Optional
+
+from khipu_tpu.storage.datasource import (
+    BlockDataSource,
+    KeyValueDataSource,
+    NodeDataSource,
+)
+
+
+class _SqliteTable:
+    def __init__(self, data_dir: str, topic: str):
+        os.makedirs(data_dir, exist_ok=True)
+        self._path = os.path.join(data_dir, f"{topic}.sqlite")
+        self._local = threading.local()
+        with self._conn() as c:
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS kv"
+                " (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
+            )
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        return conn
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        row = self._conn().execute(
+            "SELECT v FROM kv WHERE k = ?", (key,)
+        ).fetchone()
+        return row[0] if row else None
+
+    def update(self, to_remove, to_upsert) -> None:
+        conn = self._conn()
+        with conn:
+            conn.executemany(
+                "DELETE FROM kv WHERE k = ?", [(bytes(k),) for k in to_remove]
+            )
+            conn.executemany(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)",
+                [(bytes(k), bytes(v)) for k, v in to_upsert.items()],
+            )
+
+    @property
+    def count(self) -> int:
+        return self._conn().execute("SELECT COUNT(*) FROM kv").fetchone()[0]
+
+    def max_key8(self) -> int:
+        row = self._conn().execute(
+            "SELECT MAX(k) FROM kv WHERE LENGTH(k) = 8"
+        ).fetchone()
+        return int.from_bytes(row[0], "big") if row and row[0] else -1
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+class SqliteKeyValueDataSource(KeyValueDataSource):
+    def __init__(self, data_dir: str, topic: str):
+        super().__init__()
+        self._table = _SqliteTable(data_dir, topic)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        t0 = self.clock.start()
+        try:
+            return self._table.get(bytes(key))
+        finally:
+            self.clock.elapse(t0)
+
+    def update(self, to_remove, to_upsert) -> None:
+        self._table.update(to_remove, to_upsert)
+
+    @property
+    def count(self) -> int:
+        return self._table.count
+
+    def stop(self) -> None:
+        self._table.close()
+
+
+class SqliteNodeDataSource(SqliteKeyValueDataSource, NodeDataSource):
+    """Content-addressed node store over sqlite. Removes are swallowed
+    (archive semantics, NodeStorage.scala:16-19)."""
+
+    def update(self, to_remove, to_upsert) -> None:
+        self._table.update([], to_upsert)
+
+
+class SqliteBlockDataSource(BlockDataSource):
+    def __init__(self, data_dir: str, topic: str):
+        super().__init__()
+        self._table = _SqliteTable(data_dir, topic)
+        self._best = self._table.max_key8()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(number: int) -> bytes:
+        return int(number).to_bytes(8, "big")
+
+    def get(self, number: int) -> Optional[bytes]:
+        t0 = self.clock.start()
+        try:
+            return self._table.get(self._key(number))
+        finally:
+            self.clock.elapse(t0)
+
+    def update(self, to_remove, to_upsert) -> None:
+        with self._lock:
+            self._table.update(
+                [self._key(n) for n in to_remove],
+                {self._key(n): v for n, v in to_upsert.items()},
+            )
+            for n in to_upsert:
+                if int(n) > self._best:
+                    self._best = int(n)
+            if to_remove:
+                self._best = self._table.max_key8()
+
+    @property
+    def best_block_number(self) -> int:
+        return self._best
+
+    @property
+    def count(self) -> int:
+        return self._table.count
+
+    def stop(self) -> None:
+        self._table.close()

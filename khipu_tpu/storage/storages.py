@@ -5,7 +5,8 @@ account/storage/evmcode NodeStorages, header/body/receipts/td block
 storages, blocknum, tx, appState; bestBlockNumber = min(bestBody,
 bestReceipts) :40; swithToWithUnconfirmed:46 / clearUnconfirmed:63 fan
 out to all) and ServiceBoard.scala:99-138 engine selection by
-``db.engine`` — engines here: ``memory`` | ``native`` (C++ append-log).
+``db.engine`` — engines: ``memory`` | ``native`` (C++ append-log,
+Kesque role) | ``sqlite`` (embedded-KV alternative, LMDB/RocksDB role).
 """
 
 from __future__ import annotations
@@ -48,6 +49,18 @@ class Storages:
             node_src = lambda topic: NativeNodeDataSource(data_dir, topic)
             block_src = lambda topic: NativeBlockDataSource(data_dir, topic)
             kv_src = lambda topic: NativeKeyValueDataSource(data_dir, topic)
+        elif engine == "sqlite":
+            if data_dir is None:
+                raise ValueError("sqlite engine requires data_dir")
+            from khipu_tpu.storage.sqlite_engine import (
+                SqliteBlockDataSource,
+                SqliteKeyValueDataSource,
+                SqliteNodeDataSource,
+            )
+
+            node_src = lambda topic: SqliteNodeDataSource(data_dir, topic)
+            block_src = lambda topic: SqliteBlockDataSource(data_dir, topic)
+            kv_src = lambda topic: SqliteKeyValueDataSource(data_dir, topic)
         else:
             raise ValueError(f"unknown db.engine {engine!r}")
 

@@ -184,17 +184,22 @@ def finalize(
         else:
             removed[h] = rec
 
-    # Resolve EVERY placeholder the session ever created (the staged
-    # store retains them): a window's intermediate block roots are
-    # superseded by later blocks (net refcount 0 — dead for PERSISTING)
-    # yet their resolved hashes are exactly what the per-block root
-    # checks compare against. Only live ones are written out below.
-    all_phs: Dict[bytes, bytes] = {
-        ph: enc
-        for ph, enc in trie._staged.items()
-        if _is_placeholder(ph)
-    }
-    structures = {ph: rlp_decode(enc) for ph, enc in all_phs.items()}
+    if return_mapping:
+        # Resolve EVERY placeholder the session created (the staged
+        # store retains them): a window's intermediate block roots are
+        # superseded by later blocks (net refcount 0 — dead for
+        # PERSISTING) yet their resolved hashes are what the per-block
+        # root checks compare against. Only live ones persist below.
+        to_resolve: Dict[bytes, bytes] = {
+            ph: enc
+            for ph, enc in trie._staged.items()
+            if _is_placeholder(ph)
+        }
+    else:
+        # plain batch commit: nobody reads dead placeholders — hash
+        # only the live set (work scales with live nodes, not churn)
+        to_resolve = live
+    structures = {ph: rlp_decode(enc) for ph, enc in to_resolve.items()}
     deps: Dict[bytes, List[bytes]] = {}
     for ph, struct in structures.items():
         children: List[bytes] = []

@@ -1,0 +1,104 @@
+"""gRPC bridge tests (SURVEY §2.9 north-star channel): block batches
+over real gRPC -> executed, persisted, roots returned; invalid blocks
+rejected with a status error."""
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.config import fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+
+grpc = pytest.importorskip("grpc")
+
+from khipu_tpu.bridge import BridgeClient, BridgeServer  # noqa: E402
+
+CFG = fixture_config(chain_id=1)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(3)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ALLOC = {a: 10**21 for a in ADDRS}
+
+
+def build_blocks(n=4):
+    builder = ChainBuilder(
+        Blockchain(Storages(), CFG), CFG, GenesisSpec(alloc=ALLOC)
+    )
+    return [
+        builder.add_block(
+            [sign_transaction(
+                Transaction(i, 10**9, 21000, ADDRS[1], 5), KEYS[0],
+                chain_id=1,
+            )],
+            coinbase=b"\xaa" * 20,
+        )
+        for i in range(n)
+    ]
+
+
+@pytest.fixture()
+def bridge():
+    bc = Blockchain(Storages(), CFG)
+    bc.load_genesis(GenesisSpec(alloc=ALLOC))
+    server = BridgeServer(bc, CFG)
+    port = server.start()
+    client = BridgeClient(f"127.0.0.1:{port}")
+    yield client, bc
+    client.close()
+    server.stop()
+
+
+class TestBridge:
+    def test_ping(self, bridge):
+        client, _ = bridge
+        assert client.ping(b"khipu") == b"khipu"
+
+    def test_execute_batch_and_query(self, bridge):
+        client, bc = bridge
+        blocks = build_blocks(4)
+        results = client.execute_blocks(blocks)
+        assert [n for n, _ in results] == [1, 2, 3, 4]
+        for block, (n, root) in zip(blocks, results):
+            assert root == block.header.state_root
+        # server persisted the chain
+        n, h = client.best_block()
+        assert n == 4 and h == blocks[-1].hash
+        assert client.get_state_root(4) == blocks[-1].header.state_root
+        assert bc.get_account(ADDRS[1], blocks[-1].header.state_root)
+
+    def test_incremental_batches(self, bridge):
+        client, _ = bridge
+        blocks = build_blocks(4)
+        client.execute_blocks(blocks[:2])
+        client.execute_blocks(blocks[2:])
+        assert client.best_block()[0] == 4
+
+    def test_invalid_block_aborts(self, bridge):
+        import dataclasses
+
+        from khipu_tpu.domain.block import Block
+
+        client, _ = bridge
+        blocks = build_blocks(1)
+        bad = Block(
+            dataclasses.replace(blocks[0].header, state_root=b"\x13" * 32),
+            blocks[0].body,
+        )
+        with pytest.raises(grpc.RpcError) as e:
+            client.execute_blocks([bad])
+        assert e.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert client.best_block()[0] == 0  # nothing persisted
+
+    def test_malformed_batch_rejected(self, bridge):
+        client, _ = bridge
+        with pytest.raises(grpc.RpcError) as e:
+            client._call("ExecuteBlocks", b"\xff\xff not rlp")
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_unknown_root_empty(self, bridge):
+        client, _ = bridge
+        assert client.get_state_root(99) is None
