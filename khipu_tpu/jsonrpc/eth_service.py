@@ -254,6 +254,30 @@ class EthService:
     def eth_syncing(self):
         return False
 
+    def khipu_metrics(self) -> dict:
+        """Metrics surface (SURVEY §5.5): storage counters + clocks +
+        chain head, one structured snapshot."""
+        s = self.blockchain.storages
+        out = {
+            "bestBlockNumber": self.blockchain.best_block_number,
+            "pendingTxs": len(self.tx_pool),
+            "stores": {},
+        }
+        for name, store in (
+            ("account", s.account_node_storage),
+            ("storage", s.storage_node_storage),
+            ("evmcode", s.evmcode_storage),
+        ):
+            src = store.source
+            out["stores"][name] = {
+                "cacheHitRate": round(store.cache_hit_rate, 4),
+                "cacheReadCount": store.cache_read_count,
+                "count": getattr(src, "count", None),
+                "readSeconds": round(src.clock.elapsed_ns / 1e9, 6)
+                if hasattr(src, "clock") else None,
+            }
+        return out
+
     # ------------------------------------------------------------ codecs
 
     @staticmethod

@@ -131,6 +131,7 @@ class DiscoveryService:
         self.sock.bind((ip, port))
         self.ip, self.port = self.sock.getsockname()
         self._pongs: Dict[bytes, float] = {}
+        self._sent_pings: Dict[bytes, float] = {}  # hash -> sent time
         self._neighbours: List[list] = []
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -163,7 +164,19 @@ class DiscoveryService:
             node.endpoint(),
             self._expiration(),
         ]
-        self._send(node, PING, body)
+        packet = encode_packet(self.priv, PING, body)
+        now = time.time()
+        # prune unanswered pings older than the protocol expiration —
+        # bounds memory and stops ancient pong replays being accepted
+        self._sent_pings = {
+            h: t for h, t in self._sent_pings.items()
+            if now - t < EXPIRATION
+        }
+        self._sent_pings[packet[:32]] = now
+        try:
+            self.sock.sendto(packet, (node.ip, node.udp_port))
+        except OSError:
+            pass
 
     def find_node(self, node: NodeRecord, target_pub: bytes) -> None:
         self._send(node, FINDNODE, [target_pub, self._expiration()])
@@ -181,24 +194,35 @@ class DiscoveryService:
                 packet, addr = self.sock.recvfrom(1280)
             except OSError:
                 return
+            # any single malformed packet (bad RLP, short body, bogus
+            # IP bytes) must never kill the receive thread — it is the
+            # node's only ear
             try:
                 pubkey, ptype, body = decode_packet(packet)
-            except (ValueError, SignatureError):
+                self._handle(pubkey, addr, ptype, body, packet)
+            except Exception:
                 continue
-            self._handle(pubkey, addr, ptype, body)
 
-    def _handle(self, pubkey, addr, ptype, body) -> None:
+    def _handle(self, pubkey, addr, ptype, body, packet: bytes) -> None:
         sender = NodeRecord(pubkey, addr[0], addr[1], addr[1])
         if ptype == PING:
             exp = from_bytes(body[3])
             if exp < time.time():
                 return
             self.table.add(sender)
+            # discv4: PONG echoes the PING packet's hash; peers drop
+            # pongs that do not
             self._send(
                 sender, PONG,
-                [sender.endpoint(), keccak256(b""), self._expiration()],
+                [sender.endpoint(), packet[:32], self._expiration()],
             )
         elif ptype == PONG:
+            # accept only pongs answering a ping WE sent (echoed hash
+            # check) — unsolicited pongs would poison the table
+            echoed = body[1]
+            sent_at = self._sent_pings.pop(echoed, None)
+            if sent_at is None or time.time() - sent_at >= EXPIRATION:
+                return
             self.table.add(sender)
             self._pongs[pubkey] = time.time()
         elif ptype == FINDNODE:

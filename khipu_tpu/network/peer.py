@@ -41,6 +41,16 @@ class PeerError(Exception):
     pass
 
 
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise PeerError("connection closed")
+        out += chunk
+    return out
+
+
 class Peer:
     """One live connection. ``request(code, body)`` sends and blocks for
     the matching response code (PeerEntity's ask pattern)."""
@@ -55,6 +65,7 @@ class Peer:
         self.status: Optional[Status] = None
         self.snappy = False
         self._send_lock = threading.Lock()
+        # code -> FIFO of (Event, result-box) waiters
         self._waiters: Dict[int, list] = {}
         self._wlock = threading.Lock()
         self.handlers: Dict[int, Callable] = {}
@@ -72,13 +83,7 @@ class Peer:
             self.sock.sendall(self.codec.write_frame(payload))
 
     def _recv_exact(self, n: int) -> bytes:
-        out = b""
-        while len(out) < n:
-            chunk = self.sock.recv(n - len(out))
-            if not chunk:
-                raise PeerError("connection closed")
-            out += chunk
-        return out
+        return recv_exact(self.sock, n)
 
     def recv(self) -> Tuple[int, object]:
         size = self.codec.read_header(self._recv_exact(32))
@@ -138,7 +143,9 @@ class Peer:
                 with self._wlock:
                     waiters = self._waiters.get(code)
                     if waiters:
-                        waiters.pop(0).append(body)
+                        event, box = waiters.pop(0)
+                        box.append(body)
+                        event.set()
                         continue
                 handler = self.handlers.get(code)
                 if handler is not None:
@@ -153,27 +160,31 @@ class Peer:
 
     def request(self, send_code: int, body, reply_code: int,
                 timeout: float = 5.0):
-        """Send and wait for the reply code (ask pattern)."""
-        event_box: list = []
+        """Send and block for the reply code (ask pattern)."""
+        event = threading.Event()
+        box: list = []
+        waiter = (event, box)
         with self._wlock:
-            self._waiters.setdefault(reply_code, []).append(event_box)
+            self._waiters.setdefault(reply_code, []).append(waiter)
         try:
             self.send(send_code, body)
             deadline = time.time() + timeout
-            while time.time() < deadline:
-                if event_box:
-                    return event_box[0]
+            while True:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise PeerError(f"timeout awaiting code {reply_code}")
+                # wake periodically to notice a dead peer
+                if event.wait(min(remaining, 0.25)):
+                    return box[0]
                 if not self.alive:
                     raise PeerError("peer died awaiting reply")
-                time.sleep(0.005)
-            raise PeerError(f"timeout awaiting code {reply_code}")
         finally:
             # drop the waiter if unanswered — a stale box would swallow
             # the NEXT reply for this code and desync pairing forever
             with self._wlock:
                 waiters = self._waiters.get(reply_code, [])
-                if event_box in waiters and not event_box:
-                    waiters.remove(event_box)
+                if waiter in waiters and not box:
+                    waiters.remove(waiter)
 
     def disconnect(self, reason: int = 0x08) -> None:
         try:
@@ -232,16 +243,23 @@ class PeerManager:
         if self.blacklist.is_blacklisted(remote_pub):
             raise PeerError("peer is blacklisted")
         sock = socket.create_connection((host, port), timeout=timeout)
-        hs = AuthHandshake(self.static_priv)
-        auth = hs.create_auth(remote_pub)
-        sock.sendall(auth)
-        ack_prefix = self._read_exact(sock, 2)
-        size = struct.unpack(">H", ack_prefix)[0]
-        ack = ack_prefix + self._read_exact(sock, size)
-        secrets = hs.handle_ack(ack)
-        peer = Peer(sock, FrameCodec(secrets), remote_pub, inbound=False)
-        self._finish(peer)
-        return peer
+        try:
+            hs = AuthHandshake(self.static_priv)
+            auth = hs.create_auth(remote_pub)
+            sock.sendall(auth)
+            ack_prefix = recv_exact(sock, 2)
+            size = struct.unpack(">H", ack_prefix)[0]
+            ack = ack_prefix + recv_exact(sock, size)
+            secrets = hs.handle_ack(ack)
+            peer = Peer(sock, FrameCodec(secrets), remote_pub, inbound=False)
+            self._finish(peer)
+            return peer
+        except Exception:
+            try:
+                sock.close()  # failed handshake must not leak the fd
+            except OSError:
+                pass
+            raise
 
     def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -263,9 +281,9 @@ class PeerManager:
 
     def _handle_inbound(self, sock: socket.socket) -> None:
         try:
-            prefix = self._read_exact(sock, 2)
+            prefix = recv_exact(sock, 2)
             size = struct.unpack(">H", prefix)[0]
-            auth = prefix + self._read_exact(sock, size)
+            auth = prefix + recv_exact(sock, size)
             hs = AuthHandshake(self.static_priv)
             remote_pub = hs.handle_auth(auth)
             if self.blacklist.is_blacklisted(remote_pub):
@@ -299,16 +317,6 @@ class PeerManager:
         finally:
             with self._lock:
                 self._reserved -= 1
-
-    @staticmethod
-    def _read_exact(sock: socket.socket, n: int) -> bytes:
-        out = b""
-        while len(out) < n:
-            chunk = sock.recv(n - len(out))
-            if not chunk:
-                raise PeerError("connection closed")
-            out += chunk
-        return out
 
     def stop(self) -> None:
         server, self._server = self._server, None

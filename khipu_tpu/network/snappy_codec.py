@@ -42,12 +42,22 @@ def _write_varint(n: int) -> bytes:
 
 
 def compress(data: bytes) -> bytes:
-    """All-literal encoding: varint(len) + 60-byte literal chunks."""
+    """All-literal encoding: varint(len) + ONE extended-length literal
+    (tags 60-63 carry a 1-4 byte little-endian length) — O(1) overhead
+    regardless of payload size."""
     out = bytearray(_write_varint(len(data)))
-    for pos in range(0, len(data), 60):
-        chunk = data[pos : pos + 60]
-        out.append((len(chunk) - 1) << 2)  # literal tag, inline length
-        out.extend(chunk)
+    if not data:
+        return bytes(out)
+    n = len(data)
+    if n <= 60:
+        out.append((n - 1) << 2)
+    else:
+        length_bytes = (n - 1).to_bytes(
+            ((n - 1).bit_length() + 7) // 8, "little"
+        )
+        out.append((59 + len(length_bytes)) << 2)  # tag 60..63
+        out.extend(length_bytes)
+    out.extend(data)
     return bytes(out)
 
 

@@ -50,7 +50,14 @@ class ServiceBoard:
         path = os.path.join(data_dir, "nodekey")
         if os.path.exists(path):
             with open(path, "rb") as f:
-                return f.read(32)
+                key = f.read()
+            if len(key) != 32:
+                raise ValueError(
+                    f"corrupt nodekey at {path}: {len(key)} bytes "
+                    "(expected 32) — refusing to boot with a mangled "
+                    "node identity"
+                )
+            return key
         os.makedirs(data_dir, exist_ok=True)
         key = secrets.token_bytes(32)
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)

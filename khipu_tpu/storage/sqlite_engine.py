@@ -27,6 +27,8 @@ class _SqliteTable:
         os.makedirs(data_dir, exist_ok=True)
         self._path = os.path.join(data_dir, f"{topic}.sqlite")
         self._local = threading.local()
+        self._all_conns = []  # every thread's connection, for close()
+        self._conns_lock = threading.Lock()
         with self._conn() as c:
             c.execute(
                 "CREATE TABLE IF NOT EXISTS kv"
@@ -36,10 +38,16 @@ class _SqliteTable:
     def _conn(self) -> sqlite3.Connection:
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = sqlite3.connect(self._path)
+            # check_same_thread=False: each connection is still used by
+            # exactly one thread for queries, but close() runs on the
+            # shutdown thread — the default guard would make those
+            # closes silently fail and pin -wal/-shm forever
+            conn = sqlite3.connect(self._path, check_same_thread=False)
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=NORMAL")
             self._local.conn = conn
+            with self._conns_lock:
+                self._all_conns.append(conn)
         return conn
 
     def get(self, key: bytes) -> Optional[bytes]:
@@ -70,10 +78,17 @@ class _SqliteTable:
         return int.from_bytes(row[0], "big") if row and row[0] else -1
 
     def close(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+        # close EVERY thread's connection (RPC/bridge/peer workers each
+        # opened their own) — sqlite allows cross-thread close and this
+        # releases the -wal/-shm pins
+        with self._conns_lock:
+            conns, self._all_conns = self._all_conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except sqlite3.Error:
+                pass
+        self._local.conn = None
 
 
 class SqliteKeyValueDataSource(KeyValueDataSource):
