@@ -3,10 +3,11 @@
 Role of the reference's ECDSASignature (khipu-eth/.../crypto/
 ECDSASignature.scala:115 recover, :480 sign via spongycastle): tx-sender
 recovery with EIP-155 replay protection and low-s (EIP-2) enforcement.
-Pure Python over Jacobian coordinates — sender recovery sits on the host
-path (device work is hashing), and at fixture-chain scale (~ms/recover)
-it is far from the bottleneck; a C++ fast path can slot in behind the
-same functions if replay profiling ever says otherwise.
+The curve's double-scalar multiplication — the hot loop of
+recover/verify/ECDH/keygen — runs in C++ (native/csrc/secp256k1.cc,
+differential-tested against the pure-Python Jacobian ladder kept here
+as the no-toolchain fallback); protocol math (RFC 6979, mod-n algebra,
+recid bookkeeping) stays in Python.
 
 Tested against the EIP-155 example transaction (signing hash, v/r/s,
 sender round-trip) and cross-validated against the OpenSSL-backed
@@ -30,6 +31,75 @@ HALF_N = N // 2
 
 # Affine point = (x, y) ints, or None for infinity.
 Point = Optional[Tuple[int, int]]
+
+# ---------------------------------------------------------- native path
+# C++ double-scalar multiplication (native/csrc/secp256k1.cc) — the hot
+# ~4k field mults of recover/verify/ECDH/keygen. Protocol math (RFC
+# 6979, mod-n algebra, recid bookkeeping) stays in Python; falls back
+# to the pure-Python Jacobian ladder when no toolchain is available.
+
+_native_checked = False
+_native_lib = None
+
+
+def _native():
+    global _native_checked, _native_lib
+    if not _native_checked:
+        _native_checked = True
+        try:
+            from khipu_tpu.native.build import load_library
+
+            lib = load_library()
+            if lib is not None and hasattr(lib, "khipu_ec_mul_add"):
+                import ctypes
+
+                lib.khipu_ec_mul_add.argtypes = [ctypes.c_char_p] * 6 + [
+                    ctypes.c_char_p,
+                    ctypes.c_char_p,
+                ]
+                lib.khipu_ec_mul_add.restype = ctypes.c_int
+                _native_lib = lib
+        except Exception:
+            _native_lib = None
+    return _native_lib
+
+
+def _mul_add(p1: Point, k1: int, p2: Point, k2: int,
+             use_g1: bool = False, use_g2: bool = False) -> Point:
+    """k1*P1 + k2*P2 (use_gN selects the generator for that base)."""
+    lib = _native()
+    if lib is not None:
+        import ctypes
+
+        def enc(p, use_g):
+            if use_g:
+                return None, None
+            return (p[0].to_bytes(32, "big"), p[1].to_bytes(32, "big"))
+
+        outx = ctypes.create_string_buffer(32)
+        outy = ctypes.create_string_buffer(32)
+        a = enc(p1, use_g1) if k1 else (None, None)
+        b = enc(p2, use_g2) if k2 else (None, None)
+        rc = lib.khipu_ec_mul_add(
+            a[0], a[1], k1.to_bytes(32, "big") if k1 else None,
+            b[0], b[1], k2.to_bytes(32, "big") if k2 else None,
+            outx, outy,
+        )
+        if rc == 1:
+            return None
+        return (
+            int.from_bytes(outx.raw, "big"),
+            int.from_bytes(outy.raw, "big"),
+        )
+    # pure-Python fallback
+    acc: _JPoint = _J_INF
+    if k1:
+        base1 = (GX, GY) if use_g1 else p1
+        acc = _j_mul(_to_jacobian(base1), k1)
+    if k2:
+        base2 = (GX, GY) if use_g2 else p2
+        acc = _j_add(acc, _j_mul(_to_jacobian(base2), k2))
+    return _from_jacobian(acc)
 
 
 class SignatureError(Exception):
@@ -111,7 +181,9 @@ def _j_mul(p: _JPoint, k: int) -> _JPoint:
 
 
 def point_mul(p: Point, k: int) -> Point:
-    return _from_jacobian(_j_mul(_to_jacobian(p), k))
+    if p is None or k % N == 0:
+        return None
+    return _mul_add(p, k % N, None, 0)
 
 
 def point_add(p: Point, q: Point) -> Point:
@@ -136,7 +208,7 @@ def privkey_to_pubkey(priv: bytes) -> bytes:
     d = int.from_bytes(priv, "big")
     if not 0 < d < N:
         raise SignatureError("private key out of range")
-    pub = _from_jacobian(_j_mul(_G, d))
+    pub = _mul_add(None, d, None, 0, use_g1=True)
     return pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
 
 
@@ -185,7 +257,7 @@ def ecdsa_sign(msg_hash: bytes, priv: bytes) -> Tuple[int, int, int]:
         raise SignatureError("private key out of range")
     z = int.from_bytes(msg_hash, "big")
     for k in _rfc6979_gen(msg_hash, priv):
-        R = _from_jacobian(_j_mul(_G, k))
+        R = _mul_add(None, k, None, 0, use_g1=True)
         r = R[0] % N
         if r == 0:
             continue  # next k from the RFC 6979 K/V update loop
@@ -222,14 +294,12 @@ def ecdsa_recover(msg_hash: bytes, recid: int, r: int, s: int) -> bytes:
         raise SignatureError("r is not an x-coordinate on the curve")
     if (y & 1) != (recid & 1):
         y = P - y
-    Rj: _JPoint = (x, y, 1)
     z = int.from_bytes(msg_hash, "big")
     rinv = pow(r, -1, N)
     # Q = r^-1 * (s*R - z*G)
     u1 = (-z * rinv) % N
     u2 = (s * rinv) % N
-    Qj = _j_add(_j_mul(_G, u1), _j_mul(Rj, u2))
-    Q = _from_jacobian(Qj)
+    Q = _mul_add(None, u1, (x, y), u2, use_g1=True)
     if Q is None:
         raise SignatureError("recovered point at infinity")
     return Q[0].to_bytes(32, "big") + Q[1].to_bytes(32, "big")
@@ -246,7 +316,7 @@ def ecdsa_verify(msg_hash: bytes, pubkey_xy: bytes, r: int, s: int) -> bool:
     sinv = pow(s, -1, N)
     u1 = (z * sinv) % N
     u2 = (r * sinv) % N
-    p = _from_jacobian(_j_add(_j_mul(_G, u1), _j_mul((x, y, 1), u2)))
+    p = _mul_add(None, u1, (x, y), u2, use_g1=True)
     if p is None:
         return False
     return p[0] % N == r

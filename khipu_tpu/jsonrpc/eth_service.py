@@ -254,6 +254,83 @@ class EthService:
     def eth_syncing(self):
         return False
 
+    # ------------------------------------------------------- logs/filters
+
+    def _parse_log_query(self, params: dict):
+        from khipu_tpu.jsonrpc.filters import LogQuery
+
+        from_block = self._resolve_block(params.get("fromBlock", "latest"))
+        to_block = self._resolve_block(params.get("toBlock", "latest"))
+        addr = params.get("address")
+        if addr is None:
+            addresses = ()
+        elif isinstance(addr, list):
+            addresses = tuple(parse_data(a) for a in addr)
+        else:
+            addresses = (parse_data(addr),)
+        topics = []
+        for t in params.get("topics", []) or []:
+            if t is None:
+                topics.append(())
+            elif isinstance(t, list):
+                topics.append(tuple(parse_data(x) for x in t))
+            else:
+                topics.append((parse_data(t),))
+        return LogQuery(from_block, to_block, addresses, tuple(topics))
+
+    @staticmethod
+    def _log_json(hit) -> dict:
+        return {
+            "address": data(hit.address),
+            "topics": [data(t) for t in hit.topics],
+            "data": data(hit.data),
+            "blockNumber": qty(hit.block_number),
+            "blockHash": data(hit.block_hash),
+            "transactionHash": data(hit.tx_hash),
+            "transactionIndex": qty(hit.tx_index),
+            "logIndex": qty(hit.log_index),
+            "removed": False,
+        }
+
+    def eth_getLogs(self, params: dict) -> list:
+        from khipu_tpu.jsonrpc.filters import get_logs
+
+        query = self._parse_log_query(params)
+        if query.to_block - query.from_block > 10_000:
+            raise RpcError(-32005, "block range too large (max 10000)")
+        return [
+            self._log_json(h) for h in get_logs(self.blockchain, query)
+        ]
+
+    @property
+    def _filters(self):
+        from khipu_tpu.jsonrpc.filters import FilterManager
+
+        fm = getattr(self, "_filter_manager", None)
+        if fm is None:
+            fm = self._filter_manager = FilterManager(self.blockchain)
+        return fm
+
+    def eth_newFilter(self, params: dict) -> str:
+        return qty(self._filters.new_log_filter(
+            self._parse_log_query(params)
+        ))
+
+    def eth_newBlockFilter(self) -> str:
+        return qty(self._filters.new_block_filter())
+
+    def eth_uninstallFilter(self, fid: str) -> bool:
+        return self._filters.uninstall(parse_qty(fid))
+
+    def eth_getFilterChanges(self, fid: str) -> list:
+        out = self._filters.changes(parse_qty(fid))
+        if out is None:
+            raise RpcError(-32000, "filter not found")
+        return [
+            data(x) if isinstance(x, bytes) else self._log_json(x)
+            for x in out
+        ]
+
     def khipu_metrics(self) -> dict:
         """Metrics surface (SURVEY §5.5): storage counters + clocks +
         chain head, one structured snapshot."""

@@ -1,0 +1,156 @@
+"""Log/block filters + eth_getLogs.
+
+Parity: jsonrpc/FilterManager.scala:86 (log/block/pendingTx filters
+with polling) and EthService.getLogs. Queries use each block's header
+bloom as a pre-filter (ledger/BloomFilter role) before touching its
+receipts — the same pruning real nodes rely on.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from khipu_tpu.domain.blockchain import Blockchain
+from khipu_tpu.ledger.bloom import bloom_contains
+
+
+@dataclass
+class LogQuery:
+    from_block: int
+    to_block: int
+    addresses: Sequence[bytes] = ()  # empty = any
+    # topics[i] = tuple of alternatives for position i; empty tuple = any
+    topics: Sequence[Sequence[bytes]] = ()
+
+
+@dataclass
+class LogHit:
+    address: bytes
+    topics: tuple
+    data: bytes
+    block_number: int
+    block_hash: bytes
+    tx_hash: bytes
+    tx_index: int
+    log_index: int
+
+
+def _matches(log, query: LogQuery) -> bool:
+    if query.addresses and log.address not in query.addresses:
+        return False
+    for i, alternatives in enumerate(query.topics):
+        if not alternatives:
+            continue
+        if i >= len(log.topics) or log.topics[i] not in alternatives:
+            return False
+    return True
+
+
+def _bloom_may_match(bloom: bytes, query: LogQuery) -> bool:
+    if query.addresses and not any(
+        bloom_contains(bloom, a) for a in query.addresses
+    ):
+        return False
+    for alternatives in query.topics:
+        if alternatives and not any(
+            bloom_contains(bloom, t) for t in alternatives
+        ):
+            return False
+    return True
+
+
+def get_logs(blockchain: Blockchain, query: LogQuery) -> List[LogHit]:
+    hits: List[LogHit] = []
+    for number in range(query.from_block, query.to_block + 1):
+        header = blockchain.get_header_by_number(number)
+        if header is None:
+            continue
+        if not _bloom_may_match(header.logs_bloom, query):
+            continue  # bloom prunes the receipt read entirely
+        receipts = blockchain.get_receipts(number)
+        block = blockchain.get_block_by_number(number)
+        if receipts is None or block is None:
+            continue
+        log_index = 0
+        for tx_index, receipt in enumerate(receipts):
+            for log in receipt.logs:
+                if _matches(log, query):
+                    hits.append(
+                        LogHit(
+                            address=log.address,
+                            topics=tuple(log.topics),
+                            data=log.data,
+                            block_number=number,
+                            block_hash=block.hash,
+                            tx_hash=block.body.transactions[tx_index].hash,
+                            tx_index=tx_index,
+                            log_index=log_index,
+                        )
+                    )
+                log_index += 1
+    return hits
+
+
+class FilterManager:
+    """Installed filters with poll semantics (eth_newFilter /
+    eth_getFilterChanges / eth_uninstallFilter)."""
+
+    def __init__(self, blockchain: Blockchain):
+        self.blockchain = blockchain
+        self._ids = itertools.count(1)
+        self._filters = {}
+        self._lock = threading.Lock()
+
+    def new_log_filter(self, query: LogQuery) -> int:
+        with self._lock:
+            fid = next(self._ids)
+            self._filters[fid] = (
+                "logs", query, self.blockchain.best_block_number
+            )
+            return fid
+
+    def new_block_filter(self) -> int:
+        with self._lock:
+            fid = next(self._ids)
+            self._filters[fid] = (
+                "blocks", None, self.blockchain.best_block_number
+            )
+            return fid
+
+    def uninstall(self, fid: int) -> bool:
+        with self._lock:
+            return self._filters.pop(fid, None) is not None
+
+    def changes(self, fid: int):
+        """New results since the last poll."""
+        with self._lock:
+            entry = self._filters.get(fid)
+            if entry is None:
+                return None
+            kind, query, last_seen = entry
+        best = self.blockchain.best_block_number
+        if kind == "blocks":
+            out = [
+                self.blockchain.get_header_by_number(n).hash
+                for n in range(last_seen + 1, best + 1)
+            ]
+        else:
+            import dataclasses
+
+            window = dataclasses.replace(
+                query,
+                from_block=max(query.from_block, last_seen + 1),
+                to_block=min(query.to_block, best),
+            )
+            out = (
+                get_logs(self.blockchain, window)
+                if window.from_block <= window.to_block
+                else []
+            )
+        with self._lock:
+            if fid in self._filters:
+                self._filters[fid] = (kind, query, best)
+        return out

@@ -1,0 +1,94 @@
+"""Miner: seal blocks from the pending pool with Ethash.
+
+Parity: mining/Miner.scala:40 + mining/BlockGenerator.scala:31 — the
+generator prepares a block via the ledger (prepareBlock role: execute
+pending txs, fill the roots), the miner searches a nonce whose
+hashimoto result satisfies the difficulty bound, then the block is
+saved and the mined txs leave the pool (RegularSyncService.scala:419).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.config import KhipuConfig
+from khipu_tpu.consensus.ethash import EthashCache, mine
+from khipu_tpu.domain.block import Block
+from khipu_tpu.domain.blockchain import Blockchain
+from khipu_tpu.ledger.ledger import BlockExecutionError
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.txpool import PendingTransactionsPool
+
+
+class Miner:
+    def __init__(
+        self,
+        blockchain: Blockchain,
+        config: KhipuConfig,
+        tx_pool: PendingTransactionsPool,
+        coinbase: bytes,
+        ethash_cache: Optional[EthashCache] = None,
+        full_size: Optional[int] = None,
+    ):
+        self.blockchain = blockchain
+        self.config = config
+        self.tx_pool = tx_pool
+        self.coinbase = coinbase
+        self.cache = ethash_cache  # None = seal-less (dev chains)
+        self.full_size = full_size
+        self._builder = ChainBuilder.__new__(ChainBuilder)
+        self._builder.blockchain = blockchain
+        self._builder.config = config
+
+    def _select_txs(self) -> List:
+        """Pending txs ordered (sender, nonce); invalid ones dropped at
+        execution time by retrying without the offender."""
+        txs = self.tx_pool.pending()
+        return sorted(
+            txs, key=lambda t: (t.sender or b"", t.tx.nonce)
+        )
+
+    def mine_next(self) -> Block:
+        """Prepare, (optionally) seal, save one block; returns it."""
+        head = self.blockchain.get_block_by_number(
+            self.blockchain.best_block_number
+        )
+        self._builder.head = head
+        txs = self._select_txs()
+        while True:
+            try:
+                block = self._builder.add_block(
+                    tuple(txs), coinbase=self.coinbase
+                )
+                break
+            except BlockExecutionError as e:
+                # drop the offending tx (stale nonce / drained balance)
+                index = getattr(e, "index", None)
+                if index is None or index >= len(txs):
+                    raise
+                evicted = txs.pop(index)
+                self.tx_pool.remove_mined([evicted])
+        if self.cache is not None:
+            # re-seal: mine a nonce over the prepared header
+            header = block.header
+            pow_hash = keccak256(header.encode_without_nonce())
+            nonce, mix = mine(
+                self.cache, pow_hash, header.difficulty,
+                full_size=self.full_size,
+            )
+            import dataclasses
+
+            sealed_header = dataclasses.replace(
+                header, nonce=nonce.to_bytes(8, "big"), mix_hash=mix
+            )
+            # re-save under the sealed hash (roots are unchanged)
+            sealed = Block(sealed_header, block.body)
+            receipts = self.blockchain.get_receipts(block.number) or []
+            td = self.blockchain.get_total_difficulty(block.number) or 0
+            self.blockchain.remove_block(block.hash)
+            self.blockchain.save_block(sealed, receipts, td)
+            self._builder.head = sealed
+            block = sealed
+        self.tx_pool.remove_mined(block.body.transactions)
+        return block

@@ -1,0 +1,349 @@
+// secp256k1 curve arithmetic (mod-p field + Jacobian points) in C++.
+//
+// Role parity: the reference leans on spongycastle's native-speed ECDSA
+// (crypto/ECDSASignature.scala:115 recover with cached Q precompute).
+// Python keeps the protocol layer (RFC 6979, recid bookkeeping, mod-n
+// scalar algebra — a handful of big-int ops per signature); this file
+// supplies the hot part: double-scalar multiplication k1*A + k2*B over
+// the curve, which dominates recover/verify/ECDH at ~4k field
+// multiplications each.
+//
+// Field: p = 2^256 - 2^32 - 977. 4x64-bit limbs, little-endian;
+// products reduce via the special form (fold high limbs times
+// 2^32 + 977 into the low half).
+//
+// C ABI (ctypes, khipu_tpu/native/secp.py):
+//   khipu_ec_mul_add(ax, ay, k1, bx, by, k2, outx, outy) -> int
+//     computes k1*A + k2*B; a null ax means A = G (same for bx).
+//     k = NULL or zero skips that term. Returns 0 on success, 1 if the
+//     result is the point at infinity.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+typedef unsigned __int128 u128;
+
+struct Fe {
+  uint64_t v[4];
+};
+
+constexpr Fe P = {{0xFFFFFFFEFFFFFC2FULL, 0xFFFFFFFFFFFFFFFFULL,
+                   0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL}};
+// 2^256 mod p = 2^32 + 977
+constexpr uint64_t kFold = 0x1000003D1ULL;
+
+constexpr Fe GX = {{0x59F2815B16F81798ULL, 0x029BFCDB2DCE28D9ULL,
+                    0x55A06295CE870B07ULL, 0x79BE667EF9DCBBACULL}};
+constexpr Fe GY = {{0x9C47D08FFB10D4B8ULL, 0xFD17B448A6855419ULL,
+                    0x5DA4FBFC0E1108A8ULL, 0x483ADA7726A3C465ULL}};
+
+inline bool fe_is_zero(const Fe& a) {
+  return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+inline int fe_cmp(const Fe& a, const Fe& b) {
+  for (int i = 3; i >= 0; --i) {
+    if (a.v[i] < b.v[i]) return -1;
+    if (a.v[i] > b.v[i]) return 1;
+  }
+  return 0;
+}
+
+inline void fe_sub_p_if_ge(Fe& a) {
+  if (fe_cmp(a, P) >= 0) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; ++i) {
+      u128 d = (u128)a.v[i] - P.v[i] - (uint64_t)borrow;
+      a.v[i] = (uint64_t)d;
+      borrow = (d >> 64) ? 1 : 0;
+    }
+  }
+}
+
+inline void fe_add(Fe& r, const Fe& a, const Fe& b) {
+  u128 carry = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 s = (u128)a.v[i] + b.v[i] + (uint64_t)carry;
+    r.v[i] = (uint64_t)s;
+    carry = s >> 64;
+  }
+  if (carry) {  // fold 2^256 -> kFold
+    u128 s = (u128)r.v[0] + kFold;
+    r.v[0] = (uint64_t)s;
+    u128 c = s >> 64;
+    for (int i = 1; c && i < 4; ++i) {
+      s = (u128)r.v[i] + (uint64_t)c;
+      r.v[i] = (uint64_t)s;
+      c = s >> 64;
+    }
+  }
+  fe_sub_p_if_ge(r);
+}
+
+inline void fe_sub(Fe& r, const Fe& a, const Fe& b) {
+  u128 borrow = 0;
+  Fe t;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a.v[i] - b.v[i] - (uint64_t)borrow;
+    t.v[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  if (borrow) {  // add p back
+    u128 carry = 0;
+    for (int i = 0; i < 4; ++i) {
+      u128 s = (u128)t.v[i] + P.v[i] + (uint64_t)carry;
+      t.v[i] = (uint64_t)s;
+      carry = s >> 64;
+    }
+  }
+  r = t;
+}
+
+// full 256x256 -> 512 multiply, then fold twice
+void fe_mul(Fe& r, const Fe& a, const Fe& b) {
+  uint64_t w[8] = {0};
+  for (int i = 0; i < 4; ++i) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 cur = (u128)a.v[i] * b.v[j] + w[i + j] + (uint64_t)carry;
+      w[i + j] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+    w[i + 4] += (uint64_t)carry;
+  }
+  // fold high half: r = low + high * kFold (kFold < 2^33 so the
+  // product of a 256-bit high by kFold is < 2^290; do it limbwise)
+  uint64_t low[5] = {w[0], w[1], w[2], w[3], 0};
+  u128 carry = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 cur = (u128)w[4 + i] * kFold + low[i] + (uint64_t)carry;
+    low[i] = (uint64_t)cur;
+    carry = cur >> 64;
+  }
+  low[4] = (uint64_t)carry;
+  // second fold of the (small) overflow limb
+  u128 cur = (u128)low[4] * kFold + low[0];
+  Fe t;
+  t.v[0] = (uint64_t)cur;
+  u128 c = cur >> 64;
+  for (int i = 1; i < 4; ++i) {
+    u128 s = (u128)low[i] + (uint64_t)c;
+    t.v[i] = (uint64_t)s;
+    c = s >> 64;
+  }
+  if (c) {  // one more tiny fold
+    u128 s = (u128)t.v[0] + kFold;
+    t.v[0] = (uint64_t)s;
+    u128 c2 = s >> 64;
+    for (int i = 1; c2 && i < 4; ++i) {
+      s = (u128)t.v[i] + (uint64_t)c2;
+      t.v[i] = (uint64_t)s;
+      c2 = s >> 64;
+    }
+  }
+  fe_sub_p_if_ge(t);
+  r = t;
+}
+
+inline void fe_sqr(Fe& r, const Fe& a) { fe_mul(r, a, a); }
+
+void fe_pow(Fe& r, const Fe& base, const Fe& exp) {
+  Fe result = {{1, 0, 0, 0}};
+  Fe b = base;
+  for (int limb = 0; limb < 4; ++limb) {
+    uint64_t e = exp.v[limb];
+    for (int bit = 0; bit < 64; ++bit) {
+      if (e & 1) fe_mul(result, result, b);
+      e >>= 1;
+      fe_sqr(b, b);
+    }
+  }
+  r = result;
+}
+
+void fe_inv(Fe& r, const Fe& a) {
+  Fe p2 = P;
+  // p - 2
+  p2.v[0] -= 2;  // no borrow: low limb ends ...FC2F
+  fe_pow(r, a, p2);
+}
+
+// Jacobian point; inf encoded as z == 0
+struct Pt {
+  Fe x, y, z;
+};
+
+inline bool pt_is_inf(const Pt& p) { return fe_is_zero(p.z); }
+
+void pt_double(Pt& r, const Pt& p) {
+  if (pt_is_inf(p) || fe_is_zero(p.y)) {
+    r = {{{0}}, {{0}}, {{0}}};
+    return;
+  }
+  Fe ysq, s, m, t;
+  fe_sqr(ysq, p.y);
+  fe_mul(s, p.x, ysq);
+  Fe four = {{4, 0, 0, 0}};
+  fe_mul(s, s, four);
+  fe_sqr(m, p.x);
+  Fe three = {{3, 0, 0, 0}};
+  fe_mul(m, m, three);
+  Fe x2, two = {{2, 0, 0, 0}};
+  fe_sqr(x2, m);
+  fe_mul(t, s, two);
+  fe_sub(x2, x2, t);
+  Fe y2, ysq2, eight = {{8, 0, 0, 0}};
+  fe_sub(t, s, x2);
+  fe_mul(y2, m, t);
+  fe_sqr(ysq2, ysq);
+  fe_mul(ysq2, ysq2, eight);
+  fe_sub(y2, y2, ysq2);
+  Fe z2;
+  fe_mul(z2, p.y, p.z);
+  fe_mul(z2, z2, two);
+  r.x = x2;
+  r.y = y2;
+  r.z = z2;
+}
+
+void pt_add(Pt& r, const Pt& p, const Pt& q) {
+  if (pt_is_inf(p)) { r = q; return; }
+  if (pt_is_inf(q)) { r = p; return; }
+  Fe z1z1, z2z2, u1, u2, s1, s2;
+  fe_sqr(z1z1, p.z);
+  fe_sqr(z2z2, q.z);
+  fe_mul(u1, p.x, z2z2);
+  fe_mul(u2, q.x, z1z1);
+  Fe t;
+  fe_mul(t, q.z, z2z2);
+  fe_mul(s1, p.y, t);
+  fe_mul(t, p.z, z1z1);
+  fe_mul(s2, q.y, t);
+  if (fe_cmp(u1, u2) == 0) {
+    if (fe_cmp(s1, s2) != 0) {
+      r = {{{0}}, {{0}}, {{0}}};
+      return;
+    }
+    pt_double(r, p);
+    return;
+  }
+  Fe h, rr, hh, hhh, v;
+  fe_sub(h, u2, u1);
+  fe_sub(rr, s2, s1);
+  fe_sqr(hh, h);
+  fe_mul(hhh, h, hh);
+  fe_mul(v, u1, hh);
+  Fe x3, two = {{2, 0, 0, 0}};
+  fe_sqr(x3, rr);
+  fe_sub(x3, x3, hhh);
+  fe_mul(t, v, two);
+  fe_sub(x3, x3, t);
+  Fe y3;
+  fe_sub(t, v, x3);
+  fe_mul(y3, rr, t);
+  Fe s1hhh;
+  fe_mul(s1hhh, s1, hhh);
+  fe_sub(y3, y3, s1hhh);
+  Fe z3;
+  fe_mul(z3, p.z, q.z);
+  fe_mul(z3, z3, h);
+  r.x = x3;
+  r.y = y3;
+  r.z = z3;
+}
+
+void pt_mul(Pt& r, const Pt& p, const Fe& k) {
+  Pt acc = {{{0}}, {{0}}, {{0}}};
+  Pt add = p;
+  for (int limb = 0; limb < 4; ++limb) {
+    uint64_t e = k.v[limb];
+    for (int bit = 0; bit < 64; ++bit) {
+      if (e & 1) pt_add(acc, acc, add);
+      e >>= 1;
+      pt_double(add, add);
+    }
+  }
+  r = acc;
+}
+
+void fe_from_be(Fe& r, const uint8_t* b) {
+  for (int i = 0; i < 4; ++i) {
+    uint64_t limb = 0;
+    for (int j = 0; j < 8; ++j) limb = (limb << 8) | b[(3 - i) * 8 + j];
+    r.v[i] = limb;
+  }
+}
+
+void fe_to_be(uint8_t* b, const Fe& a) {
+  for (int i = 0; i < 4; ++i) {
+    uint64_t limb = a.v[3 - i];
+    for (int j = 7; j >= 0; --j) {
+      b[i * 8 + j] = (uint8_t)limb;
+      limb >>= 8;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// k1*A + k2*B in affine out coords. ax/bx NULL => that base is G.
+// k1/k2 NULL or zero => term skipped. Returns 1 for infinity.
+int khipu_ec_mul_add(const uint8_t* ax, const uint8_t* ay,
+                     const uint8_t* k1, const uint8_t* bx,
+                     const uint8_t* by, const uint8_t* k2,
+                     uint8_t* outx, uint8_t* outy) {
+  Pt acc = {{{0}}, {{0}}, {{0}}};
+  const Fe one = {{1, 0, 0, 0}};
+  if (k1) {
+    Fe s;
+    fe_from_be(s, k1);
+    if (!fe_is_zero(s)) {
+      Pt a;
+      if (ax) {
+        fe_from_be(a.x, ax);
+        fe_from_be(a.y, ay);
+      } else {
+        a.x = GX;
+        a.y = GY;
+      }
+      a.z = one;
+      Pt t;
+      pt_mul(t, a, s);
+      pt_add(acc, acc, t);
+    }
+  }
+  if (k2) {
+    Fe s;
+    fe_from_be(s, k2);
+    if (!fe_is_zero(s)) {
+      Pt b;
+      if (bx) {
+        fe_from_be(b.x, bx);
+        fe_from_be(b.y, by);
+      } else {
+        b.x = GX;
+        b.y = GY;
+      }
+      b.z = one;
+      Pt t;
+      pt_mul(t, b, s);
+      pt_add(acc, acc, t);
+    }
+  }
+  if (pt_is_inf(acc)) return 1;
+  Fe zinv, zinv2, zinv3, x, y;
+  fe_inv(zinv, acc.z);
+  fe_sqr(zinv2, zinv);
+  fe_mul(zinv3, zinv2, zinv);
+  fe_mul(x, acc.x, zinv2);
+  fe_mul(y, acc.y, zinv3);
+  fe_to_be(outx, x);
+  fe_to_be(outy, y);
+  return 0;
+}
+
+}  // extern "C"

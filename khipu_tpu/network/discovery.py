@@ -132,6 +132,7 @@ class DiscoveryService:
         self.ip, self.port = self.sock.getsockname()
         self._pongs: Dict[bytes, float] = {}
         self._sent_pings: Dict[bytes, float] = {}  # hash -> sent time
+        self._pings_lock = threading.Lock()  # recv thread vs callers
         self._neighbours: List[list] = []
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -168,11 +169,13 @@ class DiscoveryService:
         now = time.time()
         # prune unanswered pings older than the protocol expiration —
         # bounds memory and stops ancient pong replays being accepted
-        self._sent_pings = {
-            h: t for h, t in self._sent_pings.items()
-            if now - t < EXPIRATION
-        }
-        self._sent_pings[packet[:32]] = now
+        with self._pings_lock:
+            for h in [
+                h for h, t in self._sent_pings.items()
+                if now - t >= EXPIRATION
+            ]:
+                del self._sent_pings[h]
+            self._sent_pings[packet[:32]] = now
         try:
             self.sock.sendto(packet, (node.ip, node.udp_port))
         except OSError:
@@ -220,7 +223,8 @@ class DiscoveryService:
             # accept only pongs answering a ping WE sent (echoed hash
             # check) — unsolicited pongs would poison the table
             echoed = body[1]
-            sent_at = self._sent_pings.pop(echoed, None)
+            with self._pings_lock:
+                sent_at = self._sent_pings.pop(echoed, None)
             if sent_at is None or time.time() - sent_at >= EXPIRATION:
                 return
             self.table.add(sender)

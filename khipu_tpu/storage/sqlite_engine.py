@@ -47,7 +47,25 @@ class _SqliteTable:
             conn.execute("PRAGMA synchronous=NORMAL")
             self._local.conn = conn
             with self._conns_lock:
-                self._all_conns.append(conn)
+                # close + drop connections whose owner thread is gone —
+                # thread-per-request servers would otherwise pin one fd
+                # per request forever; weakrefs track thread liveness
+                import weakref
+
+                alive = []
+                for c, tref in self._all_conns:
+                    owner = tref()
+                    if owner is not None and owner.is_alive():
+                        alive.append((c, tref))
+                    else:
+                        try:
+                            c.close()
+                        except sqlite3.Error:
+                            pass
+                self._all_conns = alive
+                self._all_conns.append(
+                    (conn, weakref.ref(threading.current_thread()))
+                )
         return conn
 
     def get(self, key: bytes) -> Optional[bytes]:
@@ -83,7 +101,7 @@ class _SqliteTable:
         # releases the -wal/-shm pins
         with self._conns_lock:
             conns, self._all_conns = self._all_conns, []
-        for conn in conns:
+        for conn, _tref in conns:
             try:
                 conn.close()
             except sqlite3.Error:

@@ -1,0 +1,216 @@
+"""Miner + log filters tests (parity targets mining/Miner.scala:40,
+BlockGenerator.scala:31, jsonrpc/FilterManager.scala:86)."""
+
+import pytest
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.config import fixture_config
+from khipu_tpu.consensus.ethash import EthashCache, check_pow
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import (
+    Transaction,
+    contract_address,
+    sign_transaction,
+)
+from khipu_tpu.jsonrpc import EthService
+from khipu_tpu.jsonrpc.filters import LogQuery, get_logs
+from khipu_tpu.mining import Miner
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.txpool import PendingTransactionsPool
+
+CFG = fixture_config(chain_id=1)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(3)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ALLOC = {a: 10**21 for a in ADDRS}
+
+# contract whose runtime LOG1s topic 0x..42 with 32 bytes of data
+# runtime: PUSH32 <data> PUSH1 0 MSTORE PUSH32 <topic> PUSH1 32 PUSH1 0 LOG1 STOP
+_TOPIC = (0x42).to_bytes(32, "big")
+RUNTIME = (
+    bytes([0x7F]) + b"\xab" * 32 + bytes.fromhex("600052")
+    + bytes([0x7F]) + _TOPIC + bytes.fromhex("60206000a100")
+)
+_SS = b""
+_COPY = bytes(
+    [0x60, len(RUNTIME), 0x60, 12, 0x60, 0x00, 0x39,
+     0x60, len(RUNTIME), 0x60, 0x00, 0xF3]
+)
+INIT = _COPY + RUNTIME
+
+
+def fresh_chain():
+    bc = Blockchain(Storages(), CFG)
+    builder = ChainBuilder(bc, CFG, GenesisSpec(alloc=ALLOC))
+    return bc, builder
+
+
+class TestMiner:
+    def test_mines_pool_txs_without_seal(self):
+        bc, _ = fresh_chain()
+        pool = PendingTransactionsPool()
+        pool.add(sign_transaction(
+            Transaction(0, 10**9, 21000, ADDRS[1], 7), KEYS[0], chain_id=1
+        ))
+        pool.add(sign_transaction(
+            Transaction(0, 10**9, 21000, ADDRS[2], 9), KEYS[1], chain_id=1
+        ))
+        miner = Miner(bc, CFG, pool, coinbase=b"\xaa" * 20)
+        block = miner.mine_next()
+        assert block.number == 1
+        assert len(block.body.transactions) == 2
+        assert len(pool) == 0  # mined txs removed
+        assert bc.get_account(
+            ADDRS[1], block.header.state_root
+        ).balance == 10**21 + 7
+
+    def test_drops_invalid_tx_and_mines_rest(self):
+        bc, _ = fresh_chain()
+        pool = PendingTransactionsPool()
+        pool.add(sign_transaction(
+            Transaction(5, 10**9, 21000, ADDRS[1], 1), KEYS[0], chain_id=1
+        ))  # wrong nonce: invalid
+        pool.add(sign_transaction(
+            Transaction(0, 10**9, 21000, ADDRS[0], 3), KEYS[1], chain_id=1
+        ))
+        miner = Miner(bc, CFG, pool, coinbase=b"\xaa" * 20)
+        block = miner.mine_next()
+        assert len(block.body.transactions) == 1
+        assert block.body.transactions[0].sender == ADDRS[1]
+
+    def test_sealed_mining_validates(self):
+        bc, _ = fresh_chain()
+        pool = PendingTransactionsPool()
+        pool.add(sign_transaction(
+            Transaction(0, 10**9, 21000, ADDRS[1], 1), KEYS[0], chain_id=1
+        ))
+        cache = EthashCache(0, cache_bytes=64 * 256)
+        full = 64 * 1024
+        # dev-grade difficulty so the seal search ends quickly
+        import dataclasses
+
+        from khipu_tpu.config import BlockchainConfig
+
+        low_diff = dataclasses.replace(
+            CFG,
+            blockchain=dataclasses.replace(
+                CFG.blockchain, chain_id=1
+            ),
+        )
+        bc2 = Blockchain(Storages(), low_diff)
+        builder = ChainBuilder(
+            bc2, low_diff,
+            GenesisSpec(alloc=ALLOC, difficulty=4),
+        )
+        del builder
+        miner = Miner(
+            bc2, low_diff, pool, coinbase=b"\xaa" * 20,
+            ethash_cache=cache, full_size=full,
+        )
+        block = miner.mine_next()
+        pow_hash = keccak256(block.header.encode_without_nonce())
+        assert check_pow(
+            cache, pow_hash, block.header.mix_hash,
+            int.from_bytes(block.header.nonce, "big"),
+            block.header.difficulty, full,
+        )
+        # the sealed block is the stored head
+        assert bc2.get_header_by_number(1).hash == block.hash
+
+
+class TestFilters:
+    @pytest.fixture()
+    def chain_with_logs(self):
+        bc, builder = fresh_chain()
+        deploy = sign_transaction(
+            Transaction(0, 10**9, 300_000, None, 0, INIT), KEYS[0],
+            chain_id=1,
+        )
+        builder.add_block([deploy], coinbase=b"\xaa" * 20)
+        caddr = contract_address(ADDRS[0], 0)
+        # two blocks that emit the log + one quiet transfer block
+        builder.add_block(
+            [sign_transaction(
+                Transaction(1, 10**9, 100_000, caddr, 0), KEYS[0], chain_id=1
+            )],
+            coinbase=b"\xaa" * 20,
+        )
+        builder.add_block(
+            [sign_transaction(
+                Transaction(0, 10**9, 21_000, ADDRS[1], 1), KEYS[1], chain_id=1
+            )],
+            coinbase=b"\xaa" * 20,
+        )
+        builder.add_block(
+            [sign_transaction(
+                Transaction(2, 10**9, 100_000, caddr, 0), KEYS[0], chain_id=1
+            )],
+            coinbase=b"\xaa" * 20,
+        )
+        return bc, builder, caddr
+
+    def test_get_logs_by_address_and_topic(self, chain_with_logs):
+        bc, _, caddr = chain_with_logs
+        hits = get_logs(bc, LogQuery(0, 4, addresses=(caddr,)))
+        assert [h.block_number for h in hits] == [2, 4]
+        assert all(h.topics[0] == _TOPIC for h in hits)
+        assert all(h.data == b"\xab" * 32 for h in hits)
+        # topic filter
+        assert get_logs(
+            bc, LogQuery(0, 4, topics=((_TOPIC,),))
+        ) == hits
+        assert get_logs(
+            bc, LogQuery(0, 4, topics=((b"\x00" * 32,),))
+        ) == []
+        # range restriction
+        assert [h.block_number for h in get_logs(
+            bc, LogQuery(3, 4, addresses=(caddr,))
+        )] == [4]
+
+    def test_eth_getLogs_rpc(self, chain_with_logs):
+        bc, _, caddr = chain_with_logs
+        svc = EthService(bc, CFG)
+        out = svc.eth_getLogs({
+            "fromBlock": "0x0", "toBlock": "latest",
+            "address": "0x" + caddr.hex(),
+        })
+        assert len(out) == 2
+        assert out[0]["blockNumber"] == "0x2"
+        assert out[0]["topics"] == ["0x" + _TOPIC.hex()]
+
+    def test_filter_polling(self, chain_with_logs):
+        bc, builder, caddr = chain_with_logs
+        svc = EthService(bc, CFG)
+        fid = svc.eth_newFilter({
+            "fromBlock": "0x0", "toBlock": hex(10**6),
+            "address": "0x" + caddr.hex(),
+        })
+        first = svc.eth_getFilterChanges(fid)
+        assert len(first) == 2  # catches up to head
+        assert svc.eth_getFilterChanges(fid) == []  # no new blocks
+        # new block with a log -> one new change
+        builder.add_block(
+            [sign_transaction(
+                Transaction(3, 10**9, 100_000, caddr, 0), KEYS[0], chain_id=1
+            )],
+            coinbase=b"\xaa" * 20,
+        )
+        assert len(svc.eth_getFilterChanges(fid)) == 1
+        assert svc.eth_uninstallFilter(fid)
+        from khipu_tpu.jsonrpc.eth_service import RpcError
+
+        with pytest.raises(RpcError):
+            svc.eth_getFilterChanges(fid)
+
+    def test_block_filter(self, chain_with_logs):
+        bc, builder, _ = chain_with_logs
+        svc = EthService(bc, CFG)
+        fid = svc.eth_newBlockFilter()
+        assert svc.eth_getFilterChanges(fid) == []
+        blk = builder.add_block([], coinbase=b"\xaa" * 20)
+        changes = svc.eth_getFilterChanges(fid)
+        assert changes == ["0x" + blk.hash.hex()]

@@ -132,3 +132,48 @@ class TestInvalidInputs:
             ecdsa_sign(b"\x01" * 32, b"\x00" * 32)
         with pytest.raises(SignatureError):
             ecdsa_sign(b"\x01" * 32, N.to_bytes(32, "big"))
+
+
+class TestNativeCurveOps:
+    """C++ double-scalar multiplication (native/csrc/secp256k1.cc) vs
+    the pure-Python Jacobian ladder — bit-identical on random scalars,
+    generator bases, infinity, and the protocol round trips."""
+
+    def test_differential_vs_python(self):
+        import random
+
+        from khipu_tpu.base.crypto import secp256k1 as S
+
+        if S._native() is None:
+            pytest.skip("native toolchain unavailable")
+        random.seed(5)
+        for trial in range(25):
+            k1 = random.randrange(0, S.N)
+            k2 = random.randrange(0, S.N)
+            d = random.randrange(1, S.N)
+            base = S._from_jacobian(S._j_mul(S._G, d))
+            want = S._from_jacobian(
+                S._j_add(
+                    S._j_mul(S._G, k1),
+                    S._j_mul((base[0], base[1], 1), k2),
+                )
+            )
+            got = S._mul_add(None, k1, base, k2, use_g1=True)
+            assert got == want, f"trial {trial}"
+
+    def test_infinity_and_zero_scalars(self):
+        import random
+
+        from khipu_tpu.base.crypto import secp256k1 as S
+
+        if S._native() is None:
+            pytest.skip("native toolchain unavailable")
+        random.seed(6)
+        k = random.randrange(1, S.N)
+        # k*G + (N-k)*G == infinity
+        assert S._mul_add(
+            None, k, None, S.N - k, use_g1=True, use_g2=True
+        ) is None
+        assert S._mul_add(None, 0, None, 0) is None
+        one_g = S._mul_add(None, 1, None, 0, use_g1=True)
+        assert one_g == (S.GX, S.GY)
