@@ -60,6 +60,11 @@ class EthService:
         self.blockchain = blockchain
         self.config = config
         self.tx_pool = tx_pool or PendingTransactionsPool()
+        from khipu_tpu.jsonrpc.filters import FilterManager
+
+        # eager: a lazy-init race under concurrent RPC threads could
+        # orphan one client's installed filter ids
+        self._filter_manager = FilterManager(blockchain)
 
     # ------------------------------------------------------- block tags
 
@@ -260,7 +265,13 @@ class EthService:
         from khipu_tpu.jsonrpc.filters import LogQuery
 
         from_block = self._resolve_block(params.get("fromBlock", "latest"))
-        to_block = self._resolve_block(params.get("toBlock", "latest"))
+        to_raw = params.get("toBlock", "latest")
+        # "latest"/"pending" stay a MOVING head (None) so installed
+        # filters keep following the tip; numeric tags pin the range
+        if to_raw in ("latest", "pending", "safe", "finalized"):
+            to_block = None
+        else:
+            to_block = self._resolve_block(to_raw)
         addr = params.get("address")
         if addr is None:
             addresses = ()
@@ -296,7 +307,12 @@ class EthService:
         from khipu_tpu.jsonrpc.filters import get_logs
 
         query = self._parse_log_query(params)
-        if query.to_block - query.from_block > 10_000:
+        upper = (
+            query.to_block
+            if query.to_block is not None
+            else self.blockchain.best_block_number
+        )
+        if upper - query.from_block > 10_000:
             raise RpcError(-32005, "block range too large (max 10000)")
         return [
             self._log_json(h) for h in get_logs(self.blockchain, query)
@@ -304,12 +320,7 @@ class EthService:
 
     @property
     def _filters(self):
-        from khipu_tpu.jsonrpc.filters import FilterManager
-
-        fm = getattr(self, "_filter_manager", None)
-        if fm is None:
-            fm = self._filter_manager = FilterManager(self.blockchain)
-        return fm
+        return self._filter_manager
 
     def eth_newFilter(self, params: dict) -> str:
         return qty(self._filters.new_log_filter(

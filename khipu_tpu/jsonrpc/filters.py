@@ -20,7 +20,9 @@ from khipu_tpu.ledger.bloom import bloom_contains
 @dataclass
 class LogQuery:
     from_block: int
-    to_block: int
+    # None = moving head ("latest"): resolved at each evaluation, so an
+    # installed filter keeps following the chain tip
+    to_block: Optional[int]
     addresses: Sequence[bytes] = ()  # empty = any
     # topics[i] = tuple of alternatives for position i; empty tuple = any
     topics: Sequence[Sequence[bytes]] = ()
@@ -64,28 +66,44 @@ def _bloom_may_match(bloom: bytes, query: LogQuery) -> bool:
 
 def get_logs(blockchain: Blockchain, query: LogQuery) -> List[LogHit]:
     hits: List[LogHit] = []
-    for number in range(query.from_block, query.to_block + 1):
+    to_block = (
+        query.to_block
+        if query.to_block is not None
+        else blockchain.best_block_number
+    )
+    for number in range(query.from_block, to_block + 1):
         header = blockchain.get_header_by_number(number)
         if header is None:
             continue
         if not _bloom_may_match(header.logs_bloom, query):
             continue  # bloom prunes the receipt read entirely
         receipts = blockchain.get_receipts(number)
-        block = blockchain.get_block_by_number(number)
-        if receipts is None or block is None:
+        if receipts is None:
             continue
+        body = None  # fetched lazily: only blocks with a HIT pay it
         log_index = 0
         for tx_index, receipt in enumerate(receipts):
             for log in receipt.logs:
                 if _matches(log, query):
+                    if body is None:
+                        from khipu_tpu.domain.block import BlockBody
+
+                        raw = blockchain.storages.block_body_storage.get(
+                            number
+                        )
+                        body = (
+                            BlockBody.decode(raw)
+                            if raw is not None
+                            else BlockBody()
+                        )
                     hits.append(
                         LogHit(
                             address=log.address,
                             topics=tuple(log.topics),
                             data=log.data,
                             block_number=number,
-                            block_hash=block.hash,
-                            tx_hash=block.body.transactions[tx_index].hash,
+                            block_hash=header.hash,
+                            tx_hash=body.transactions[tx_index].hash,
                             tx_index=tx_index,
                             log_index=log_index,
                         )
@@ -107,9 +125,9 @@ class FilterManager:
     def new_log_filter(self, query: LogQuery) -> int:
         with self._lock:
             fid = next(self._ids)
-            self._filters[fid] = (
-                "logs", query, self.blockchain.best_block_number
-            )
+            # first poll catches up from the query's fromBlock (geth
+            # semantics); later polls return only the delta
+            self._filters[fid] = ("logs", query, query.from_block - 1)
             return fid
 
     def new_block_filter(self) -> int:
@@ -140,10 +158,11 @@ class FilterManager:
         else:
             import dataclasses
 
+            upper = query.to_block if query.to_block is not None else best
             window = dataclasses.replace(
                 query,
                 from_block=max(query.from_block, last_seen + 1),
-                to_block=min(query.to_block, best),
+                to_block=min(upper, best),
             )
             out = (
                 get_logs(self.blockchain, window)

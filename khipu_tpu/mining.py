@@ -37,9 +37,7 @@ class Miner:
         self.coinbase = coinbase
         self.cache = ethash_cache  # None = seal-less (dev chains)
         self.full_size = full_size
-        self._builder = ChainBuilder.__new__(ChainBuilder)
-        self._builder.blockchain = blockchain
-        self._builder.config = config
+        self._builder = ChainBuilder.from_head(blockchain, config)
 
     def _select_txs(self) -> List:
         """Pending txs ordered (sender, nonce); invalid ones dropped at
@@ -82,12 +80,16 @@ class Miner:
             sealed_header = dataclasses.replace(
                 header, nonce=nonce.to_bytes(8, "big"), mix_hash=mix
             )
-            # re-save under the sealed hash (roots are unchanged)
+            # re-save under the sealed hash: save_block OVERWRITES the
+            # number-keyed stores in place (no window where header N is
+            # missing for concurrent readers); only the stale unsealed
+            # hash->number mapping is dropped afterwards
             sealed = Block(sealed_header, block.body)
             receipts = self.blockchain.get_receipts(block.number) or []
             td = self.blockchain.get_total_difficulty(block.number) or 0
-            self.blockchain.remove_block(block.hash)
+            unsealed_hash = block.hash
             self.blockchain.save_block(sealed, receipts, td)
+            self.blockchain.storages.block_numbers.remove(unsealed_hash)
             self._builder.head = sealed
             block = sealed
         self.tx_pool.remove_mined(block.body.transactions)

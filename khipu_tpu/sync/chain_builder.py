@@ -34,6 +34,20 @@ class ChainBuilder:
         self.genesis = blockchain.load_genesis(genesis)
         self.head = self.genesis
 
+    @classmethod
+    def from_head(cls, blockchain: Blockchain,
+                  config: KhipuConfig) -> "ChainBuilder":
+        """Attach to an already-initialized chain at its current head
+        (the miner's entry point — no genesis loading)."""
+        b = cls.__new__(cls)
+        b.blockchain = blockchain
+        b.config = config
+        b.genesis = blockchain.get_block_by_number(0)
+        b.head = blockchain.get_block_by_number(
+            blockchain.best_block_number
+        )
+        return b
+
     def add_block(
         self,
         txs: Sequence[SignedTransaction] = (),
