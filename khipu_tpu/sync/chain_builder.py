@@ -20,7 +20,11 @@ from khipu_tpu.domain.transaction import SignedTransaction
 from khipu_tpu.domain.difficulty import calc_difficulty
 from khipu_tpu.ledger.bloom import bloom_union
 from khipu_tpu.ledger.ledger import execute_block
-from khipu_tpu.validators.roots import receipts_root, transactions_root
+from khipu_tpu.validators.roots import (
+    ommers_hash,
+    receipts_root,
+    transactions_root,
+)
 
 
 class ChainBuilder:
@@ -54,6 +58,7 @@ class ChainBuilder:
         coinbase: Optional[bytes] = None,
         timestamp: Optional[int] = None,
         extra_data: bytes = b"",
+        ommers: Sequence[BlockHeader] = (),
     ) -> Block:
         parent = self.head.header
         ts = (
@@ -63,7 +68,9 @@ class ChainBuilder:
         )
         header = BlockHeader(
             parent_hash=parent.hash,
-            ommers_hash=EMPTY_OMMERS_HASH,
+            ommers_hash=(
+                ommers_hash(tuple(ommers)) if ommers else EMPTY_OMMERS_HASH
+            ),
             beneficiary=coinbase or parent.beneficiary,
             state_root=b"\x00" * 32,  # filled after execution
             transactions_root=transactions_root(txs),
@@ -79,7 +86,7 @@ class ChainBuilder:
             unix_timestamp=ts,
             extra_data=extra_data,
         )
-        draft = Block(header, BlockBody(tuple(txs)))
+        draft = Block(header, BlockBody(tuple(txs), tuple(ommers)))
         result = execute_block(
             draft,
             parent.state_root,

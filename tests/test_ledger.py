@@ -378,3 +378,37 @@ class TestReviewRegressions:
                 par_cfg,
                 validate=False,
             )
+
+
+class TestOmmers:
+    def test_ommer_rewards_through_execution(self):
+        """A block including an ommer pays the ommer's beneficiary the
+        distance-scaled reward and the miner the +1/32 bonus
+        (BlockRewardCalculator.scala:11), replay-verified."""
+        import dataclasses as dc
+
+        builder, bc = new_chain()
+        b1 = builder.add_block([], coinbase=MINER)
+        # a plausible competing child of block 1's parent
+        ommer = dc.replace(
+            b1.header, beneficiary=ADDRS[5], extra_data=b"uncle"
+        )
+        b2 = builder.add_block(
+            [tx(0, 0, ADDRS[1], 1)], coinbase=MINER, ommers=(ommer,)
+        )
+        root = b2.header.state_root
+        base = 2 * ETH  # Constantinople reward (all forks active)
+        # ommer at height 1 included at height 2: (8 + 1 - 2)/8 * base
+        assert bc.get_account(ADDRS[5], root).balance == (
+            1000 * ETH + base * 7 // 8  # genesis alloc + ommer reward
+        )
+        miner_acc = bc.get_account(MINER, root)
+        # two blocks of base reward + 1/32 ommer bonus + the tx fee
+        assert miner_acc.balance == (
+            2 * base + base // 32 + 21000 * GWEI
+        )
+        # and the whole thing replays bit-exact
+        bc2 = Blockchain(Storages(), CFG)
+        bc2.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
+        ReplayDriver(bc2, CFG).replay([b1, b2])
+        assert bc2.get_header_by_number(2).hash == b2.hash
