@@ -130,6 +130,8 @@ def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1):
         ),
         conflicts=stats.conflicts,
         window=window,
+        n_blocks=n_blocks,
+        txs_per_block=txs_per_block,
     )
 
 
@@ -296,12 +298,12 @@ def bench_keccak_primary():
 
 def main() -> None:
     bench_replay(
-        200, 3, "replay_early_era_fixture_blocks_per_sec",
-        parallel=False, window=50,
+        120, 3, "replay_early_era_fixture_blocks_per_sec",
+        parallel=False, window=40,
     )
     bench_replay(
-        10, 50, "replay_parallel_commit_fixture_blocks_per_sec",
-        parallel=True, window=10,
+        8, 50, "replay_parallel_commit_fixture_blocks_per_sec",
+        parallel=True, window=8,
     )
     bench_bulk_build()
     bench_snapshot_verify()

@@ -14,7 +14,7 @@ from typing import List, Optional, Sequence
 
 from khipu_tpu.config import KhipuConfig
 from khipu_tpu.domain.block import Block, BlockBody
-from khipu_tpu.domain.block_header import EMPTY_OMMERS_HASH, BlockHeader
+from khipu_tpu.domain.block_header import BlockHeader
 from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
 from khipu_tpu.domain.transaction import SignedTransaction
 from khipu_tpu.domain.difficulty import calc_difficulty
@@ -68,9 +68,7 @@ class ChainBuilder:
         )
         header = BlockHeader(
             parent_hash=parent.hash,
-            ommers_hash=(
-                ommers_hash(tuple(ommers)) if ommers else EMPTY_OMMERS_HASH
-            ),
+            ommers_hash=ommers_hash(tuple(ommers)),
             beneficiary=coinbase or parent.beneficiary,
             state_root=b"\x00" * 32,  # filled after execution
             transactions_root=transactions_root(txs),

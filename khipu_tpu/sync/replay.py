@@ -23,6 +23,7 @@ from khipu_tpu.ledger.ledger import execute_block
 from khipu_tpu.validators.validators import (
     BlockHeaderValidator,
     BlockValidator,
+    OmmersValidator,
 )
 
 
@@ -106,6 +107,7 @@ class ReplayDriver:
                 pending[0].number - 1
             )
             window_headers = {}
+            window_headers_full = {}
 
             def block_hash_of(n: int):
                 h = window_headers.get(n)
@@ -127,6 +129,10 @@ class ReplayDriver:
                 if self.validate_headers:
                     self.header_validator.validate(header, prev)
                 BlockValidator.validate_body(block)
+                OmmersValidator.validate(
+                    self.blockchain, block,
+                    header_lookup=window_headers_full.get,
+                )
                 config = for_block(header.number, self.config.blockchain)
                 if not config.byzantium:
                     raise ValueError(
@@ -143,6 +149,7 @@ class ReplayDriver:
                 )
                 committer.commit_block(result.world, header)
                 window_headers[header.number] = header.hash
+                window_headers_full[header.number] = header
                 results.append((block, result))
                 prev = header
             committer.finalize()  # raises WindowMismatch on divergence
@@ -184,6 +191,7 @@ class ReplayDriver:
         if self.validate_headers:
             self.header_validator.validate(header, parent)
         BlockValidator.validate_body(block)
+        OmmersValidator.validate(self.blockchain, block)
 
         t0 = time.perf_counter()
         result = execute_block(

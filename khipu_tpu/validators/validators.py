@@ -78,6 +78,67 @@ class BlockHeaderValidator:
             raise HeaderValidationError("invalid PoW seal")
 
 
+class OmmersValidator:
+    """Ommer consensus rules (validators/OmmersValidator.scala): at most
+    2 ommers, no duplicates, each a valid header whose parent is an
+    ancestor of the including block within 6 generations, none equal to
+    an ancestor, none already included by a recent block."""
+
+    MAX_OMMERS = 2
+    GENERATION_LIMIT = 6
+
+    @staticmethod
+    def validate(blockchain, block: Block, header_lookup=None) -> None:
+        """``header_lookup(n) -> Optional[BlockHeader]`` overrides the
+        chain DB for ancestors not yet persisted (an open commit window
+        validates blocks whose parents live only in the window)."""
+        ommers = block.body.ommers
+        if not ommers:
+            return
+        if len(ommers) > OmmersValidator.MAX_OMMERS:
+            raise ValidationError(f"{len(ommers)} ommers > 2")
+        if len({o.hash for o in ommers}) != len(ommers):
+            raise ValidationError("duplicate ommers")
+
+        def get_header(num):
+            if header_lookup is not None:
+                h = header_lookup(num)
+                if h is not None:
+                    return h
+            return blockchain.get_header_by_number(num)
+
+        # ancestors of the including block (hashes + headers), depth 7
+        n = block.number
+        ancestors = {}
+        for depth in range(1, OmmersValidator.GENERATION_LIMIT + 2):
+            h = get_header(n - depth)
+            if h is None:
+                break
+            ancestors[h.hash] = h
+        # ommers already included by recent blocks
+        seen = set()
+        for depth in range(1, OmmersValidator.GENERATION_LIMIT + 1):
+            b = blockchain.get_block_by_number(n - depth)
+            if b is None:
+                break
+            for o in b.body.ommers:
+                seen.add(o.hash)
+
+        for o in ommers:
+            if o.hash in ancestors or o.hash == block.hash:
+                raise ValidationError("ommer is an ancestor")
+            if o.hash in seen:
+                raise ValidationError("ommer already included")
+            if not 0 < n - o.number <= OmmersValidator.GENERATION_LIMIT:
+                raise ValidationError(
+                    f"ommer depth {n - o.number} outside 1..6"
+                )
+            if o.parent_hash not in ancestors:
+                raise ValidationError(
+                    "ommer's parent is not a recent ancestor"
+                )
+
+
 class BlockValidator:
     """Body-vs-header consistency (BlockValidator.scala:19)."""
 

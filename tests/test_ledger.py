@@ -412,3 +412,44 @@ class TestOmmers:
         bc2.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
         ReplayDriver(bc2, CFG).replay([b1, b2])
         assert bc2.get_header_by_number(2).hash == b2.hash
+
+    def test_invalid_ommers_rejected(self):
+        """OmmersValidator: ancestors, depth, and duplicates rejected
+        (OmmersValidator.scala rules)."""
+        import dataclasses as dc
+
+        import pytest as _pytest
+
+        from khipu_tpu.validators.validators import (
+            OmmersValidator,
+            ValidationError,
+        )
+        from khipu_tpu.domain.block import Block, BlockBody
+
+        builder, bc = new_chain()
+        b1 = builder.add_block([], coinbase=MINER)
+        b2 = builder.add_block([], coinbase=MINER)
+
+        def block_with(ommers):
+            hdr = dc.replace(
+                b2.header, number=3, parent_hash=b2.hash
+            )
+            return Block(hdr, BlockBody((), tuple(ommers)))
+
+        # an actual ancestor as ommer
+        with _pytest.raises(ValidationError, match="ancestor"):
+            OmmersValidator.validate(bc, block_with([b1.header]))
+        # duplicate ommers
+        u = dc.replace(b1.header, extra_data=b"u")
+        with _pytest.raises(ValidationError, match="duplicate"):
+            OmmersValidator.validate(bc, block_with([u, u]))
+        # too many
+        us = [dc.replace(b1.header, extra_data=bytes([i])) for i in range(3)]
+        with _pytest.raises(ValidationError, match="> 2"):
+            OmmersValidator.validate(bc, block_with(us))
+        # parent not an ancestor
+        orphan = dc.replace(b1.header, parent_hash=b"\x77" * 32)
+        with _pytest.raises(ValidationError, match="ancestor"):
+            OmmersValidator.validate(bc, block_with([orphan]))
+        # a legitimate uncle passes
+        OmmersValidator.validate(bc, block_with([u]))
