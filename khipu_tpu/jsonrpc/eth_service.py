@@ -140,6 +140,18 @@ class EthService:
     def eth_gasPrice(self) -> str:
         return qty(10**9)
 
+    def eth_getBlockTransactionCountByNumber(self, tag) -> Optional[str]:
+        block = self.blockchain.get_block_by_number(
+            self._resolve_block(tag)
+        )
+        return qty(len(block.body.transactions)) if block else None
+
+    def eth_getUncleCountByBlockNumber(self, tag) -> Optional[str]:
+        block = self.blockchain.get_block_by_number(
+            self._resolve_block(tag)
+        )
+        return qty(len(block.body.ommers)) if block else None
+
     def eth_getBlockByNumber(self, tag, full_txs: bool = False):
         n = self._resolve_block(tag)
         block = self.blockchain.get_block_by_number(n)
@@ -303,10 +315,7 @@ class EthService:
             "removed": False,
         }
 
-    def eth_getLogs(self, params: dict) -> list:
-        from khipu_tpu.jsonrpc.filters import get_logs
-
-        query = self._parse_log_query(params)
+    def _check_log_range(self, query) -> None:
         upper = (
             query.to_block
             if query.to_block is not None
@@ -314,6 +323,12 @@ class EthService:
         )
         if upper - query.from_block > 10_000:
             raise RpcError(-32005, "block range too large (max 10000)")
+
+    def eth_getLogs(self, params: dict) -> list:
+        from khipu_tpu.jsonrpc.filters import get_logs
+
+        query = self._parse_log_query(params)
+        self._check_log_range(query)
         return [
             self._log_json(h) for h in get_logs(self.blockchain, query)
         ]
@@ -329,6 +344,22 @@ class EthService:
 
     def eth_newBlockFilter(self) -> str:
         return qty(self._filters.new_block_filter())
+
+    def eth_newPendingTransactionFilter(self) -> str:
+        return qty(self._filters.new_pending_tx_filter(self.tx_pool))
+
+    def eth_getFilterLogs(self, fid: str) -> list:
+        """Full (non-delta) result set of an installed log filter."""
+        from khipu_tpu.jsonrpc.filters import get_logs
+
+        query = self._filters.get_log_query(parse_qty(fid))
+        if query is None:
+            raise RpcError(-32000, "filter not found")
+        self._check_log_range(query)  # same DoS cap as eth_getLogs
+        return [
+            self._log_json(h)
+            for h in get_logs(self.blockchain, query)
+        ]
 
     def eth_uninstallFilter(self, fid: str) -> bool:
         return self._filters.uninstall(parse_qty(fid))

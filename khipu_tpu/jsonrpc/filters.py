@@ -138,6 +138,25 @@ class FilterManager:
             )
             return fid
 
+    def new_pending_tx_filter(self, tx_pool) -> int:
+        """Reports hashes of txs that ENTERED the pool since last poll
+        — read from the pool's arrival journal, so a tx that enters and
+        is mined/evicted between polls is still reported."""
+        with self._lock:
+            fid = next(self._ids)
+            _, cursor = tx_pool.arrivals_since(1 << 62)  # current end
+            self._filters[fid] = ("pending", tx_pool, cursor)
+            return fid
+
+    def get_log_query(self, fid: int):
+        """The installed log filter's query, or None (locked access —
+        eth_getFilterLogs must not poke at internals)."""
+        with self._lock:
+            entry = self._filters.get(fid)
+        if entry is None or entry[0] != "logs":
+            return None
+        return entry[1]
+
     def uninstall(self, fid: int) -> bool:
         with self._lock:
             return self._filters.pop(fid, None) is not None
@@ -150,6 +169,13 @@ class FilterManager:
                 return None
             kind, query, last_seen = entry
         best = self.blockchain.best_block_number
+        if kind == "pending":
+            tx_pool, cursor = query, last_seen
+            new_hashes, new_cursor = tx_pool.arrivals_since(cursor)
+            with self._lock:
+                if fid in self._filters:
+                    self._filters[fid] = ("pending", tx_pool, new_cursor)
+            return new_hashes
         if kind == "blocks":
             out = [
                 self.blockchain.get_header_by_number(n).hash

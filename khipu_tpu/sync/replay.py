@@ -108,6 +108,7 @@ class ReplayDriver:
             )
             window_headers = {}
             window_headers_full = {}
+            window_blocks = {}
 
             def block_hash_of(n: int):
                 h = window_headers.get(n)
@@ -132,6 +133,11 @@ class ReplayDriver:
                 OmmersValidator.validate(
                     self.blockchain, block,
                     header_lookup=window_headers_full.get,
+                    block_lookup=window_blocks.get,
+                    header_validator=(
+                        self.header_validator
+                        if self.validate_headers else None
+                    ),
                 )
                 config = for_block(header.number, self.config.blockchain)
                 if not config.byzantium:
@@ -150,6 +156,7 @@ class ReplayDriver:
                 committer.commit_block(result.world, header)
                 window_headers[header.number] = header.hash
                 window_headers_full[header.number] = header
+                window_blocks[header.number] = block
                 results.append((block, result))
                 prev = header
             committer.finalize()  # raises WindowMismatch on divergence
@@ -191,7 +198,12 @@ class ReplayDriver:
         if self.validate_headers:
             self.header_validator.validate(header, parent)
         BlockValidator.validate_body(block)
-        OmmersValidator.validate(self.blockchain, block)
+        OmmersValidator.validate(
+            self.blockchain, block,
+            header_validator=(
+                self.header_validator if self.validate_headers else None
+            ),
+        )
 
         t0 = time.perf_counter()
         result = execute_block(

@@ -22,6 +22,10 @@ class PendingTransactionsPool:
         self.capacity = capacity
         # insertion order IS the eviction order (oldest first)
         self._txs: "OrderedDict[bytes, SignedTransaction]" = OrderedDict()
+        # monotonic arrival journal: pending-tx filters read deltas from
+        # it, so a tx that enters AND leaves between polls still reports
+        self._arrivals: List[bytes] = []
+        self._arrival_base = 0  # journal offset after trims
         self._lock = threading.Lock()
 
     def add(self, stx: SignedTransaction) -> bool:
@@ -35,7 +39,23 @@ class PendingTransactionsPool:
             while len(self._txs) >= self.capacity:
                 self._txs.popitem(last=False)
             self._txs[stx.hash] = stx
+            self._arrivals.append(stx.hash)
+            # bound the journal: keep the most recent 4x capacity
+            if len(self._arrivals) > 4 * self.capacity:
+                trim = 2 * self.capacity
+                del self._arrivals[:trim]
+                self._arrival_base += trim
             return True
+
+    def arrivals_since(self, cursor: int):
+        """(new_hashes, new_cursor); cursors older than the retained
+        journal yield what remains (bounded retention)."""
+        with self._lock:
+            start = max(cursor - self._arrival_base, 0)
+            return (
+                list(self._arrivals[start:]),
+                self._arrival_base + len(self._arrivals),
+            )
 
     def get(self, tx_hash: bytes) -> Optional[SignedTransaction]:
         with self._lock:

@@ -88,10 +88,14 @@ class OmmersValidator:
     GENERATION_LIMIT = 6
 
     @staticmethod
-    def validate(blockchain, block: Block, header_lookup=None) -> None:
-        """``header_lookup(n) -> Optional[BlockHeader]`` overrides the
-        chain DB for ancestors not yet persisted (an open commit window
-        validates blocks whose parents live only in the window)."""
+    def validate(blockchain, block: Block, header_lookup=None,
+                 block_lookup=None, header_validator=None) -> None:
+        """``header_lookup(n)``/``block_lookup(n)`` override the chain
+        DB for blocks not yet persisted (an open commit window validates
+        blocks whose parents live only in the window);
+        ``header_validator`` additionally validates each ommer header
+        against its parent (the Scala OmmersValidator runs the full
+        BlockHeaderValidator on ommers)."""
         ommers = block.body.ommers
         if not ommers:
             return
@@ -107,6 +111,13 @@ class OmmersValidator:
                     return h
             return blockchain.get_header_by_number(num)
 
+        def get_block(num):
+            if block_lookup is not None:
+                b = block_lookup(num)
+                if b is not None:
+                    return b
+            return blockchain.get_block_by_number(num)
+
         # ancestors of the including block (hashes + headers), depth 7
         n = block.number
         ancestors = {}
@@ -115,12 +126,15 @@ class OmmersValidator:
             if h is None:
                 break
             ancestors[h.hash] = h
-        # ommers already included by recent blocks
+        # ommers already included by recent blocks (gaps skipped, not
+        # aborted — in-window neighbors come from block_lookup)
         seen = set()
         for depth in range(1, OmmersValidator.GENERATION_LIMIT + 1):
-            b = blockchain.get_block_by_number(n - depth)
-            if b is None:
+            if n - depth < 0:
                 break
+            b = get_block(n - depth)
+            if b is None:
+                continue
             for o in b.body.ommers:
                 seen.add(o.hash)
 
@@ -133,10 +147,21 @@ class OmmersValidator:
                 raise ValidationError(
                     f"ommer depth {n - o.number} outside 1..6"
                 )
-            if o.parent_hash not in ancestors:
+            parent = ancestors.get(o.parent_hash)
+            if parent is None:
                 raise ValidationError(
                     "ommer's parent is not a recent ancestor"
                 )
+            if o.number != parent.number + 1:
+                raise ValidationError(
+                    f"ommer number {o.number} != parent+1 "
+                    f"({parent.number + 1})"
+                )
+            if header_validator is not None:
+                try:
+                    header_validator.validate(o, parent)
+                except HeaderValidationError as e:
+                    raise ValidationError(f"invalid ommer header: {e}")
 
 
 class BlockValidator:

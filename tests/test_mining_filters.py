@@ -205,3 +205,56 @@ class TestFilters:
         blk = builder.add_block([], coinbase=b"\xaa" * 20)
         changes = svc.eth_getFilterChanges(fid)
         assert changes == ["0x" + blk.hash.hex()]
+
+
+class TestMoreRpc:
+    def test_pending_tx_filter_and_counts(self):
+        bc, builder = fresh_chain()
+        builder.add_block(
+            [sign_transaction(
+                Transaction(0, 10**9, 21000, ADDRS[1], 1), KEYS[0], chain_id=1
+            )],
+            coinbase=b"\xaa" * 20,
+        )
+        from khipu_tpu.txpool import PendingTransactionsPool
+
+        pool = PendingTransactionsPool()
+        svc = EthService(bc, CFG, pool)
+        fid = svc.eth_newPendingTransactionFilter()
+        assert svc.eth_getFilterChanges(fid) == []
+        stx = sign_transaction(
+            Transaction(1, 10**9, 21000, ADDRS[2], 2), KEYS[0], chain_id=1
+        )
+        svc.eth_sendRawTransaction("0x" + stx.encode().hex())
+        changes = svc.eth_getFilterChanges(fid)
+        assert changes == ["0x" + stx.hash.hex()]
+        assert svc.eth_getFilterChanges(fid) == []
+        assert svc.eth_getBlockTransactionCountByNumber("0x1") == "0x1"
+        assert svc.eth_getUncleCountByBlockNumber("0x1") == "0x0"
+        assert svc.eth_getBlockTransactionCountByNumber("0x9") is None
+
+    def test_get_filter_logs_full_set(self):
+        bc, builder = fresh_chain()
+        deploy = sign_transaction(
+            Transaction(0, 10**9, 300_000, None, 0, INIT), KEYS[0],
+            chain_id=1,
+        )
+        builder.add_block([deploy], coinbase=b"\xaa" * 20)
+        caddr = contract_address(ADDRS[0], 0)
+        builder.add_block(
+            [sign_transaction(
+                Transaction(1, 10**9, 100_000, caddr, 0), KEYS[0], chain_id=1
+            )],
+            coinbase=b"\xaa" * 20,
+        )
+        svc = EthService(bc, CFG)
+        fid = svc.eth_newFilter({"fromBlock": "0x0", "address": "0x" + caddr.hex()})
+        svc.eth_getFilterChanges(fid)  # advance the delta cursor
+        # full set stays available regardless of polling
+        logs = svc.eth_getFilterLogs(fid)
+        assert len(logs) == 1
+        from khipu_tpu.jsonrpc.eth_service import RpcError
+        import pytest as _p
+
+        with _p.raises(RpcError):
+            svc.eth_getFilterLogs("0x999")
