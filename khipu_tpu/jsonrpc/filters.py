@@ -144,8 +144,7 @@ class FilterManager:
         is mined/evicted between polls is still reported."""
         with self._lock:
             fid = next(self._ids)
-            _, cursor = tx_pool.arrivals_since(1 << 62)  # current end
-            self._filters[fid] = ("pending", tx_pool, cursor)
+            self._filters[fid] = ("pending", tx_pool, tx_pool.cursor())
             return fid
 
     def get_log_query(self, fid: int):
@@ -161,41 +160,49 @@ class FilterManager:
         with self._lock:
             return self._filters.pop(fid, None) is not None
 
+    # one poll never scans more than this many blocks; the cursor
+    # advances by at most the same amount, so a huge catch-up range is
+    # paid down incrementally instead of in one unbounded scan
+    MAX_BLOCKS_PER_POLL = 10_000
+
     def changes(self, fid: int):
         """New results since the last poll."""
         with self._lock:
+            # the whole read-advance is atomic under the manager lock:
+            # concurrent polls of one filter must neither double-deliver
+            # nor rewind the cursor (the pool lock nests inside and
+            # nothing takes them in the reverse order)
             entry = self._filters.get(fid)
             if entry is None:
                 return None
             kind, query, last_seen = entry
-        best = self.blockchain.best_block_number
-        if kind == "pending":
-            tx_pool, cursor = query, last_seen
-            new_hashes, new_cursor = tx_pool.arrivals_since(cursor)
-            with self._lock:
-                if fid in self._filters:
-                    self._filters[fid] = ("pending", tx_pool, new_cursor)
-            return new_hashes
-        if kind == "blocks":
-            out = [
-                self.blockchain.get_header_by_number(n).hash
-                for n in range(last_seen + 1, best + 1)
-            ]
-        else:
-            import dataclasses
+            if kind == "pending":
+                tx_pool = query
+                new_hashes, new_cursor = tx_pool.arrivals_since(last_seen)
+                self._filters[fid] = ("pending", tx_pool, new_cursor)
+                return new_hashes
+            best = self.blockchain.best_block_number
+            horizon = min(best, last_seen + self.MAX_BLOCKS_PER_POLL)
+            if kind == "blocks":
+                out = [
+                    self.blockchain.get_header_by_number(n).hash
+                    for n in range(last_seen + 1, horizon + 1)
+                ]
+            else:
+                import dataclasses
 
-            upper = query.to_block if query.to_block is not None else best
-            window = dataclasses.replace(
-                query,
-                from_block=max(query.from_block, last_seen + 1),
-                to_block=min(upper, best),
-            )
-            out = (
-                get_logs(self.blockchain, window)
-                if window.from_block <= window.to_block
-                else []
-            )
-        with self._lock:
-            if fid in self._filters:
-                self._filters[fid] = (kind, query, best)
-        return out
+                upper = (
+                    query.to_block if query.to_block is not None else best
+                )
+                window = dataclasses.replace(
+                    query,
+                    from_block=max(query.from_block, last_seen + 1),
+                    to_block=min(upper, horizon),
+                )
+                out = (
+                    get_logs(self.blockchain, window)
+                    if window.from_block <= window.to_block
+                    else []
+                )
+            self._filters[fid] = (kind, query, horizon)
+            return out

@@ -47,6 +47,12 @@ class PendingTransactionsPool:
                 self._arrival_base += trim
             return True
 
+    def cursor(self) -> int:
+        """Current end of the arrival journal (install point for
+        pending-tx filters)."""
+        with self._lock:
+            return self._arrival_base + len(self._arrivals)
+
     def arrivals_since(self, cursor: int):
         """(new_hashes, new_cursor); cursors older than the retained
         journal yield what remains (bounded retention)."""

@@ -316,3 +316,153 @@ class TestBN128:
         assert b.pairing_check(b"") == (1).to_bytes(32, "big")
         # malformed length fails
         assert b.pairing_check(b"\x00" * 191) is None
+
+
+def _deploy_helper(world, addr, runtime):
+    """Install runtime code + account directly for frame-semantics tests."""
+    from khipu_tpu.domain.account import Account
+
+    world.save_account(addr, Account(nonce=1))
+    world.save_code(addr, runtime)
+    return world
+
+
+class TestCallFrames:
+    """Nested-frame semantics: context, rollback, returndata."""
+
+    def test_delegatecall_uses_caller_storage(self):
+        # B's runtime: SSTORE(0, 0x77)
+        b_addr = b"\xbb" * 20
+        writer = bytes.fromhex("6077600055")
+        # A's runtime: DELEGATECALL(gas, B, 0,0,0,0) then return SLOAD(0)
+        a_code = (
+            bytes.fromhex("600060006000600073") + b_addr
+            + bytes.fromhex("620186a0f4")  # gas 100000 DELEGATECALL
+            + bytes.fromhex("5060005460005260206000f3")
+        )
+        world = fresh_world()
+        _deploy_helper(world, b_addr, writer)
+        r = run_code(a_code, world=world)
+        assert r.error is None
+        # the write landed in A's (owner's) storage, not B's
+        assert int.from_bytes(r.output, "big") == 0x77
+        assert r.world.get_storage(b"\xcc" * 20, 0) == 0x77
+        assert r.world.get_storage(b_addr, 0) == 0
+
+    def test_call_reverts_roll_back_but_gas_returns(self):
+        # B: store then REVERT with 1 byte
+        b_addr = b"\xbb" * 20
+        reverter = bytes.fromhex("607760005560016000fd")
+        # A: CALL B, then return (status << 8) | returndatasize
+        a_code = (
+            bytes.fromhex("6000600060006000600073") + b_addr
+            + bytes.fromhex("620186a0f1")  # CALL
+            + bytes.fromhex("6008") + bytes.fromhex("1b")  # shl status<<8
+            + bytes.fromhex("3d17")  # | returndatasize
+            + bytes.fromhex("60005260206000f3")
+        )
+        world = fresh_world()
+        _deploy_helper(world, b_addr, reverter)
+        r = run_code(a_code, world=world)
+        assert r.error is None
+        out = int.from_bytes(r.output, "big")
+        assert out == (0 << 8) | 1  # status 0, returndata 1 byte
+        # B's reverted SSTORE did not survive
+        assert r.world.get_storage(b_addr, 0) == 0
+
+    def test_nested_call_success_propagates_state(self):
+        # C: SSTORE(1, 5)
+        c_addr = b"\xcc\x01" + b"\x00" * 18
+        c_code = bytes.fromhex("6005600155")
+        # B: CALL C
+        b_addr = b"\xbb" * 20
+        b_code = (
+            bytes.fromhex("6000600060006000600073") + c_addr
+            + bytes.fromhex("61ea60f1") + bytes.fromhex("00")
+        )
+        world = fresh_world()
+        _deploy_helper(world, b_addr, b_code)
+        _deploy_helper(world, c_addr, c_code)
+        # A: CALL B
+        a_code = (
+            bytes.fromhex("6000600060006000600073") + b_addr
+            + bytes.fromhex("620186a0f1") + bytes.fromhex("00")
+        )
+        r = run_code(a_code, world=world)
+        assert r.error is None
+        assert r.world.get_storage(c_addr, 1) == 5  # two frames deep
+
+    def test_create2_deterministic_address_and_redeploy_collision(self):
+        from khipu_tpu.domain.transaction import create2_address
+
+        # init code returning empty runtime: just STOP
+        init = bytes.fromhex("00")
+        # owner CREATE2(value=0, off=0, size=1, salt=9) with init 0x00
+        code = (
+            bytes.fromhex("7f") + init.ljust(32, b"\x00")  # PUSH32 init
+            + bytes.fromhex("600052")
+            + bytes.fromhex("6009600160006000f5")  # salt 9 size 1 off 0 val 0
+            + bytes.fromhex("60005260206000f3")
+        )
+        world = fresh_world()
+        r = run_code(code, world=world)
+        assert r.error is None
+        got = int.from_bytes(r.output, "big").to_bytes(32, "big")[12:]
+        expect = create2_address(
+            b"\xcc" * 20, (9).to_bytes(32, "big"), init
+        )
+        assert got == expect
+        # second CREATE2 with the same salt on the same world: the
+        # account exists with nonce 1 (EIP-161) -> collision -> 0
+        r2 = run_code(code, world=r.world)
+        assert int.from_bytes(r2.output, "big") == 0
+
+    def test_staticcall_blocks_nested_write(self):
+        # B writes storage; A STATICCALLs B -> status 0, no write
+        b_addr = b"\xbb" * 20
+        writer = bytes.fromhex("6077600055")
+        a_code = (
+            bytes.fromhex("600060006000600073") + b_addr
+            + bytes.fromhex("620186a0fa")  # STATICCALL
+            + bytes.fromhex("60005260206000f3")
+        )
+        world = fresh_world()
+        _deploy_helper(world, b_addr, writer)
+        r = run_code(a_code, world=world)
+        assert r.error is None
+        assert int.from_bytes(r.output, "big") == 0  # child failed
+        assert r.world.get_storage(b_addr, 0) == 0
+
+    def test_call_depth_limit(self):
+        # self-recursive CALL: address CC..CC calls itself forever;
+        # depth cap must terminate without error and without burning
+        # the full gas on the deepest frames
+        me = b"\xcc" * 20
+        # push out_size..value zeros, PUSH20 me, GAS, CALL, return the
+        # status word — gas on top of the operand stack
+        code = (
+            bytes.fromhex("6000600060006000600073") + me
+            + bytes.fromhex("5af1")  # gas=GAS (63/64 per level)
+            + bytes.fromhex("60005260206000f3")
+        )
+        world = fresh_world()
+        _deploy_helper(world, me, code)
+        # self-recursion terminates cleanly on gas (EIP-150's 63/64 rule
+        # makes depth 1024 unreachable by gas alone — that was its point)
+        r = run_code(code, world=world, gas=3_000_000)
+        assert r.error is None
+        assert r.gas_remaining < 2_800_000  # real recursion happened
+
+        # the 1024-depth cap itself, tested directly: a frame ALREADY at
+        # max depth must have its CALL return 0 with the child gas
+        # refunded, not recurse or crash
+        env = MessageEnv(
+            owner=me, caller=b"\xdd" * 20, origin=b"\xdd" * 20,
+            gas_price=1, value=0, input_data=b"", depth=1024,
+        )
+        block = BlockEnv(1, 1000, 131072, 8_000_000, b"\xaa" * 20)
+        r2 = run(CFG, world.copy(), block, env, Program(code), 100_000)
+        assert r2.error is None
+        assert int.from_bytes(r2.output, "big") == 0  # CALL status 0
+        # child gas came back: only the frame's own ops were paid
+        assert r2.gas_remaining > 90_000
