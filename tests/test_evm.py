@@ -451,7 +451,8 @@ class TestCallFrames:
         # makes depth 1024 unreachable by gas alone — that was its point)
         r = run_code(code, world=world, gas=3_000_000)
         assert r.error is None
-        assert r.gas_remaining < 2_800_000  # real recursion happened
+        # real recursion happened: the 63/64 cascade burned >150k
+        assert r.gas_remaining < 2_850_000
 
         # the 1024-depth cap itself, tested directly: a frame ALREADY at
         # max depth must have its CALL return 0 with the child gas
