@@ -49,6 +49,28 @@ class BlockchainConfig:
     constantinople_block: int = 7_280_000
     petersburg_block: int = 7_280_000
     istanbul_block: int = 9_069_000
+    # DAO hard fork (KhipuConfig.scala:219-220 dao-fork-block-number/
+    # hash; ForkResolver.scala:18-31). The hash is OUR side's block at
+    # the fork height — defaults are the pro-fork (ETH) mainnet side.
+    dao_fork_block_number: int = 1_920_000
+    dao_fork_block_hash: Optional[bytes] = bytes.fromhex(
+        "4985f5ca3d2afbec36529aa96f74de3cc10a2a4a6c44f2157a57d2c6059a11bb"
+    )
+    # pro-fork consensus rule (geth PR#2814): blocks in
+    # [fork, fork + range) must carry exactly this extraData. None
+    # disables the rule (the contra-fork side instead REJECTS it).
+    dao_fork_extra_data: Optional[bytes] = bytes.fromhex(
+        "64616f2d686172642d666f726b"  # "dao-hard-fork"
+    )
+    dao_fork_extra_data_range: int = 10
+    # irregular state change at the fork block: each drain address's
+    # full balance moves into the refund contract before any tx runs.
+    # NOTE: the canonical mainnet list (116 child-DAO addresses ->
+    # 0xbf4ed7b2...) is chain data that must be provisioned by the
+    # operator; with an empty list a mainnet replay stops AT the fork
+    # block with a state-root mismatch rather than silently diverging.
+    dao_drain_list: tuple = ()  # 20-byte addresses
+    dao_refund_contract: Optional[bytes] = None
     # difficulty-bomb rewind schedule (DifficultyCalculator.scala:17):
     # (activation_block, total_rewind) pairs, cumulative per EIP-649
     # (-3M), EIP-1234 (-5M), EIP-2384 (-9M); the largest activated

@@ -76,6 +76,17 @@ class Blockchain:
         raw = self.storages.block_header_storage.get(number)
         return BlockHeader.decode(raw) if raw is not None else None
 
+    def get_header_by_hash(self, block_hash: bytes) -> Optional[BlockHeader]:
+        """Hash-verified lookup through the hash->number index (a stale
+        index entry after a reorg must not alias another header)."""
+        n = self.storages.block_numbers.number_of(block_hash)
+        if n is None:
+            return None
+        header = self.get_header_by_number(n)
+        if header is not None and header.hash == block_hash:
+            return header
+        return None
+
     def get_block_by_number(self, number: int) -> Optional[Block]:
         header = self.get_header_by_number(number)
         if header is None:

@@ -82,7 +82,10 @@ def get_logs(blockchain: Blockchain, query: LogQuery) -> List[LogHit]:
             continue
         body = None  # fetched lazily: only blocks with a HIT pay it
         log_index = 0
+        skip_block = False
         for tx_index, receipt in enumerate(receipts):
+            if skip_block:
+                break
             for log in receipt.logs:
                 if _matches(log, query):
                     if body is None:
@@ -91,11 +94,16 @@ def get_logs(blockchain: Blockchain, query: LogQuery) -> List[LogHit]:
                         raw = blockchain.storages.block_body_storage.get(
                             number
                         )
-                        body = (
-                            BlockBody.decode(raw)
-                            if raw is not None
-                            else BlockBody()
-                        )
+                        if raw is None:
+                            # receipts without a body (partial store /
+                            # mid-reorg): skip the whole block rather
+                            # than index into an empty tx list
+                            skip_block = True
+                            break
+                        body = BlockBody.decode(raw)
+                    if tx_index >= len(body.transactions):
+                        skip_block = True
+                        break
                     hits.append(
                         LogHit(
                             address=log.address,
@@ -184,10 +192,19 @@ class FilterManager:
             best = self.blockchain.best_block_number
             horizon = min(best, last_seen + self.MAX_BLOCKS_PER_POLL)
             if kind == "blocks":
-                out = [
-                    self.blockchain.get_header_by_number(n).hash
-                    for n in range(last_seen + 1, horizon + 1)
-                ]
+                # a header can vanish mid-scan (reorg shortened the
+                # chain after the best_block_number read): stop at the
+                # last contiguous header so the cursor never skips past
+                # blocks that were never delivered
+                out = []
+                n = last_seen + 1
+                while n <= horizon:
+                    header = self.blockchain.get_header_by_number(n)
+                    if header is None:
+                        break
+                    out.append(header.hash)
+                    n += 1
+                horizon = n - 1
             else:
                 import dataclasses
 

@@ -276,7 +276,41 @@ def execute_block(
     Blockchain facade provides it. Raises BlockExecutionError.
     """
     header = block.header
-    config = for_block(header.number, khipu_config.blockchain)
+    bc = khipu_config.blockchain
+    config = for_block(header.number, bc)
+    if validate and (
+        header.number == bc.dao_fork_block_number
+        and bc.dao_fork_block_hash is not None
+        and header.hash != bc.dao_fork_block_hash
+    ):
+        # fork-block identity: replaying the OTHER side's chain must
+        # fail here, not at some downstream root mismatch
+        # (ForkResolver.scala:20-24). Draft blocks (validate=False,
+        # chain builder) have non-final hashes and skip this.
+        raise BlockExecutionError(
+            f"block {header.number} hash {header.hash.hex()} is not the "
+            f"configured DAO fork block {bc.dao_fork_block_hash.hex()}"
+        )
+    if (
+        header.number == bc.dao_fork_block_number
+        and bc.dao_drain_list
+        and bc.dao_refund_contract is not None
+    ):
+        # irregular state change: every world built at the parent root
+        # sees the drain applied before any tx (each optimistic
+        # parallel attempt snapshots the SAME post-drain pre-state)
+        inner_make = make_world
+        refund = bc.dao_refund_contract
+        drain = bc.dao_drain_list
+
+        def make_world(root, _inner=inner_make):
+            w = _inner(root)
+            if root == parent_state_root:
+                for addr in drain:
+                    bal = w.get_balance(addr)
+                    w.transfer(addr, refund, bal)
+            return w
+
     block_env = BlockEnv(
         number=header.number,
         timestamp=header.unix_timestamp,

@@ -225,12 +225,15 @@ class PeerManager:
 
     def __init__(self, static_priv: bytes, client_id: str,
                  status_factory: Callable[[], Status],
-                 max_peers: int = 25):
+                 max_peers: int = 25, fork_resolver=None):
         self.static_priv = static_priv
         self.node_id = privkey_to_pubkey(static_priv)
         self.client_id = client_id
         self.status_factory = status_factory
         self.max_peers = max_peers
+        # DAO fork identity check, run right after the Status exchange
+        # (EtcHandshake.respondToStatus -> respondToBlockHeaders)
+        self.fork_resolver = fork_resolver
         self.peers: List[Peer] = []
         self._reserved = 0  # in-flight handshakes holding a peer slot
         self.blacklist = Blacklist()
@@ -312,6 +315,26 @@ class PeerManager:
         try:
             peer.exchange_hello(self.client_id, self.node_id)
             peer.exchange_status(self.status_factory())
+            if self.fork_resolver is not None:
+                from khipu_tpu.network.fork_resolver import (
+                    ForkCheckFailed,
+                    run_fork_challenge,
+                )
+                from khipu_tpu.network.messages import (
+                    ETH_OFFSET as _EO,
+                    GET_BLOCK_HEADERS as _GBH,
+                )
+
+                try:
+                    run_fork_challenge(
+                        peer,
+                        self.fork_resolver,
+                        serve_handler=self.handlers.get(_EO + _GBH),
+                    )
+                except ForkCheckFailed as e:
+                    self.blacklist.add(peer.remote_pub)
+                    peer.disconnect(reason=0x03)  # useless peer
+                    raise PeerError(f"fork check failed: {e}")
             peer.handlers.update(self.handlers)
             peer.start_loop()
             with self._lock:

@@ -22,13 +22,20 @@ an inlined child.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Sequence, Tuple
 
 from khipu_tpu.base.rlp import rlp_decode, rlp_encode
 from khipu_tpu.trie.bulk import Hasher, host_hasher
 from khipu_tpu.trie.mpt import BLANK, MerklePatriciaTrie
 
-_PLACEHOLDER_PREFIX = b"\xfe\xc0khipu-deferred\xc0\xfe"  # 18 bytes
+# UNFORGEABLE per-process prefix: leaf values are attacker-controlled
+# (a contract can SSTORE any 32-byte word), so a fixed magic could be
+# forged to make finalize() substitute a real node hash into stored
+# data or crash the level loop. 14 random bytes make a collision
+# 2^-112; detection additionally requires membership in the session's
+# own staged-placeholder set (see _collect_placeholders).
+_PLACEHOLDER_PREFIX = b"\xfe\xc0" + os.urandom(14) + b"\xc0\xfe"  # 18 bytes
 
 
 def _make_placeholder(counter: int) -> bytes:
@@ -146,18 +153,24 @@ def _substitute(structure, mapping: Dict[bytes, bytes]):
     return [_substitute(item, mapping) for item in structure]
 
 
-def _collect_placeholders(structure, out: List[bytes]) -> None:
+def _collect_placeholders(structure, out: List[bytes], known) -> None:
+    """Collect placeholder refs, direct or embedded. ``known`` is the
+    session's own placeholder set — a prefix match that is NOT a key the
+    session handed out is opaque user data, never a dependency."""
     if isinstance(structure, bytes):
         if _is_placeholder(structure):
-            out.append(structure)
+            if structure in known:
+                out.append(structure)
         else:
             pos = structure.find(_PLACEHOLDER_PREFIX)
             while pos >= 0:
-                out.append(structure[pos : pos + 32])
+                ph = structure[pos : pos + 32]
+                if ph in known:
+                    out.append(ph)
                 pos = structure.find(_PLACEHOLDER_PREFIX, pos + 32)
         return
     for item in structure:
-        _collect_placeholders(item, out)
+        _collect_placeholders(item, out, known)
 
 
 def finalize(
@@ -200,10 +213,15 @@ def finalize(
         # only the live set (work scales with live nodes, not churn)
         to_resolve = live
     structures = {ph: rlp_decode(enc) for ph, enc in to_resolve.items()}
+    # membership set = EVERY placeholder the session handed out (not
+    # just to_resolve): a reference to a session placeholder outside the
+    # resolve set must still surface as an unresolvable dependency
+    # below, never silently persist as opaque bytes
+    known = frozenset(ph for ph in trie._staged if _is_placeholder(ph))
     deps: Dict[bytes, List[bytes]] = {}
     for ph, struct in structures.items():
         children: List[bytes] = []
-        _collect_placeholders(struct, children)
+        _collect_placeholders(struct, children, known)
         deps[ph] = children
 
     resolved: Dict[bytes, bytes] = {}  # placeholder -> real hash

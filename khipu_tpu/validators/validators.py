@@ -68,6 +68,19 @@ class BlockHeaderValidator:
             raise HeaderValidationError("gas limit delta out of bounds")
         if header.gas_limit < MIN_GAS_LIMIT:
             raise HeaderValidationError("gas limit below minimum")
+        marker = self.bc.dao_fork_extra_data
+        if marker is not None and (
+            self.bc.dao_fork_block_number
+            <= header.number
+            < self.bc.dao_fork_block_number
+            + self.bc.dao_fork_extra_data_range
+        ):
+            # pro-fork consensus rule (geth PR#2814): the first N
+            # blocks after the DAO fork must carry the marker exactly
+            if header.extra_data != marker:
+                raise HeaderValidationError(
+                    "missing dao-hard-fork extra data in fork window"
+                )
         if self.difficulty_fn is not None:
             expected = self.difficulty_fn(header, parent)
             if header.difficulty != expected:
@@ -118,22 +131,40 @@ class OmmersValidator:
                     return b
             return blockchain.get_block_by_number(num)
 
-        # ancestors of the including block (hashes + headers), depth 7
+        # ancestors of the including block (hashes + headers), depth 7,
+        # collected by WALKING parent_hash links — the block may sit on
+        # a non-canonical branch, so looking up the canonical header at
+        # each height would check the wrong lineage (the reference walks
+        # getNBlocksBack from the block's parent)
         n = block.number
         ancestors = {}
-        for depth in range(1, OmmersValidator.GENERATION_LIMIT + 2):
-            h = get_header(n - depth)
+        lineage: List[BlockHeader] = []
+        cur_hash = block.header.parent_hash
+        cur_num = n - 1
+        for _depth in range(1, OmmersValidator.GENERATION_LIMIT + 2):
+            if cur_num < 0:
+                break
+            h = None
+            cand = get_header(cur_num)
+            if cand is not None and cand.hash == cur_hash:
+                h = cand
+            else:
+                by_hash = getattr(blockchain, "get_header_by_hash", None)
+                if by_hash is not None:
+                    h = by_hash(cur_hash)
             if h is None:
                 break
             ancestors[h.hash] = h
-        # ommers already included by recent blocks (gaps skipped, not
-        # aborted — in-window neighbors come from block_lookup)
+            lineage.append(h)
+            cur_hash = h.parent_hash
+            cur_num -= 1
+        # ommers already included by recent blocks ON THIS LINEAGE
+        # (bodies whose stored block no longer matches the lineage
+        # header are skipped, not trusted)
         seen = set()
-        for depth in range(1, OmmersValidator.GENERATION_LIMIT + 1):
-            if n - depth < 0:
-                break
-            b = get_block(n - depth)
-            if b is None:
+        for h in lineage[: OmmersValidator.GENERATION_LIMIT]:
+            b = get_block(h.number)
+            if b is None or b.hash != h.hash:
                 continue
             for o in b.body.ommers:
                 seen.add(o.hash)

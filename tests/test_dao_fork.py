@@ -1,0 +1,255 @@
+"""DAO fork: config knobs, irregular state change, fork-block identity,
+extraData window rule, and the peer-handshake fork challenge.
+
+Parity targets: config/KhipuConfig.scala:219-220,264-265,
+network/ForkResolver.scala:18-31, handshake/EtcHandshake.scala
+(respondToStatus -> respondToBlockHeaders).
+"""
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.config import fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.ledger.ledger import BlockExecutionError, execute_block
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.validators.validators import (
+    BlockHeaderValidator,
+    HeaderValidationError,
+)
+
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(3)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+REFUND = b"\xbf" * 20
+MARKER = bytes.fromhex("64616f2d686172642d666f726b")  # "dao-hard-fork"
+
+
+def dao_config(**overrides):
+    base = dict(
+        dao_fork_block_number=2,
+        dao_drain_list=(ADDRS[2],),
+        dao_refund_contract=REFUND,
+        dao_fork_extra_data=None,
+        dao_fork_block_hash=None,
+    )
+    base.update(overrides)
+    return fixture_config(chain_id=1, **base)
+
+
+def build_chain(cfg, n_blocks=3, coinbase=b"\xaa" * 20):
+    bc = Blockchain(Storages(), cfg)
+    builder = ChainBuilder(
+        bc, cfg, GenesisSpec(alloc={a: 10**21 for a in ADDRS})
+    )
+    for n in range(n_blocks):
+        builder.add_block(
+            [
+                sign_transaction(
+                    Transaction(n, 10**9, 21000, ADDRS[1], 5),
+                    KEYS[0],
+                    chain_id=1,
+                )
+            ],
+            coinbase=coinbase,
+        )
+    return bc
+
+
+class TestDaoStateChange:
+    def test_drain_applies_exactly_at_fork_block(self):
+        cfg = dao_config()
+        bc = build_chain(cfg)
+        # before the fork block the drained account is untouched
+        pre = bc.get_account(
+            ADDRS[2], bc.get_header_by_number(1).state_root
+        )
+        assert pre.balance == 10**21
+        refund_pre = bc.get_account(
+            REFUND, bc.get_header_by_number(1).state_root
+        )
+        assert refund_pre is None
+        # at the fork block the FULL balance moved to the refund
+        # contract (under this compressed schedule EIP-161 is already
+        # active, so the now-empty touched account is cleared — on real
+        # mainnet the fork predates Spurious Dragon and it would remain
+        # with balance 0)
+        post = bc.get_account(
+            ADDRS[2], bc.get_header_by_number(2).state_root
+        )
+        assert post is None or post.balance == 0
+        refund_post = bc.get_account(
+            REFUND, bc.get_header_by_number(2).state_root
+        )
+        assert refund_post.balance == 10**21
+        # and it does not re-apply on the next block
+        refund_later = bc.get_account(
+            REFUND, bc.get_header_by_number(3).state_root
+        )
+        assert refund_later.balance == 10**21
+
+    def test_fork_block_identity_gates_replay(self):
+        cfg = dao_config()
+        bc = build_chain(cfg)
+        block2 = bc.get_block_by_number(2)
+        parent_root = bc.get_header_by_number(1).state_root
+
+        good = dao_config(dao_fork_block_hash=block2.hash)
+        execute_block(
+            block2, parent_root, bc.get_world_state, good
+        )  # must not raise
+
+        bad = dao_config(dao_fork_block_hash=b"\xff" * 32)
+        with pytest.raises(BlockExecutionError, match="DAO fork block"):
+            execute_block(block2, parent_root, bc.get_world_state, bad)
+
+
+class TestDaoExtraDataRule:
+    def test_marker_required_in_fork_window(self):
+        cfg = dao_config(dao_fork_extra_data=MARKER)
+        bc = build_chain(dao_config(), n_blocks=2)
+        parent = bc.get_header_by_number(1)
+        header = bc.get_header_by_number(2)  # built without the marker
+        validator = BlockHeaderValidator(cfg.blockchain)
+        with pytest.raises(HeaderValidationError, match="dao-hard-fork"):
+            validator.validate(header, parent)
+
+    def test_marker_satisfies_rule_and_outside_window_unchecked(self):
+        cfg = dao_config(
+            dao_fork_extra_data=MARKER, dao_fork_extra_data_range=1
+        )
+        bc = Blockchain(Storages(), cfg)
+        builder = ChainBuilder(
+            bc, cfg, GenesisSpec(alloc={a: 10**21 for a in ADDRS})
+        )
+        builder.add_block([])  # block 1: outside window, no marker
+        builder.add_block([], extra_data=MARKER)  # block 2: fork block
+        builder.add_block([])  # block 3: window is 1 block wide
+        validator = BlockHeaderValidator(cfg.blockchain)
+        validator.validate(
+            bc.get_header_by_number(2), bc.get_header_by_number(1)
+        )
+        validator.validate(
+            bc.get_header_by_number(3), bc.get_header_by_number(2)
+        )
+
+
+class TestForkChallenge:
+    def _status_factory(self, bc):
+        from khipu_tpu.network.messages import Status
+
+        def status():
+            best = bc.best_block_number
+            return Status(
+                63,
+                1,
+                bc.get_total_difficulty(best) or 0,
+                bc.get_header_by_number(best).hash,
+                bc.get_header_by_number(0).hash,
+            )
+
+        return status
+
+    def test_wrong_fork_peer_rejected_and_blacklisted(self):
+        from khipu_tpu.network.fork_resolver import ForkResolver
+        from khipu_tpu.network.host_service import HostService
+        from khipu_tpu.network.peer import PeerError, PeerManager
+
+        cfg = dao_config()
+        ours = build_chain(cfg, coinbase=b"\xaa" * 20)
+        theirs = build_chain(cfg, coinbase=b"\xcc" * 20)  # same genesis,
+        # divergent fork block
+        assert (
+            ours.get_header_by_number(0).hash
+            == theirs.get_header_by_number(0).hash
+        )
+        assert (
+            ours.get_header_by_number(2).hash
+            != theirs.get_header_by_number(2).hash
+        )
+
+        priv_a, priv_b = KEYS[0], KEYS[1]
+        pub_b = privkey_to_pubkey(priv_b)
+        server = PeerManager(priv_b, "other-side", self._status_factory(theirs))
+        HostService(theirs).install(server)
+        port = server.listen()
+
+        resolver = ForkResolver(2, ours.get_header_by_number(2).hash)
+        client = PeerManager(
+            priv_a, "our-side", self._status_factory(ours),
+            fork_resolver=resolver,
+        )
+        try:
+            with pytest.raises(PeerError, match="fork check failed"):
+                client.connect("127.0.0.1", port, pub_b)
+            assert client.blacklist.is_blacklisted(pub_b)
+            with pytest.raises(PeerError, match="blacklisted"):
+                client.connect("127.0.0.1", port, pub_b)
+        finally:
+            client.stop()
+            server.stop()
+
+    def test_same_fork_peers_connect_with_mutual_challenge(self):
+        from khipu_tpu.network.fork_resolver import ForkResolver
+        from khipu_tpu.network.host_service import HostService
+        from khipu_tpu.network.peer import PeerManager
+
+        cfg = dao_config()
+        chain_a = build_chain(cfg)
+        chain_b = build_chain(cfg)
+        fork_hash = chain_a.get_header_by_number(2).hash
+        assert chain_b.get_header_by_number(2).hash == fork_hash
+
+        priv_a, priv_b = KEYS[0], KEYS[1]
+        pub_b = privkey_to_pubkey(priv_b)
+        server = PeerManager(
+            priv_b, "b", self._status_factory(chain_b),
+            fork_resolver=ForkResolver(2, fork_hash),
+        )
+        HostService(chain_b).install(server)
+        port = server.listen()
+        client = PeerManager(
+            priv_a, "a", self._status_factory(chain_a),
+            fork_resolver=ForkResolver(2, fork_hash),
+        )
+        HostService(chain_a).install(client)
+        try:
+            peer = client.connect("127.0.0.1", port, pub_b)
+            assert peer.alive
+            assert peer.status is not None
+        finally:
+            client.stop()
+            server.stop()
+
+    def test_unchallengeable_short_peer_assumed_friendly(self):
+        from khipu_tpu.network.fork_resolver import ForkResolver
+        from khipu_tpu.network.host_service import HostService
+        from khipu_tpu.network.peer import PeerManager
+
+        cfg = dao_config()
+        long_chain = build_chain(cfg, n_blocks=3)
+        short_chain = build_chain(cfg, n_blocks=1)  # pre-fork peer
+
+        priv_a, priv_b = KEYS[0], KEYS[1]
+        pub_b = privkey_to_pubkey(priv_b)
+        server = PeerManager(
+            priv_b, "short", self._status_factory(short_chain)
+        )
+        HostService(short_chain).install(server)
+        port = server.listen()
+        client = PeerManager(
+            priv_a, "long", self._status_factory(long_chain),
+            fork_resolver=ForkResolver(
+                2, long_chain.get_header_by_number(2).hash
+            ),
+        )
+        try:
+            peer = client.connect("127.0.0.1", port, pub_b)
+            assert peer.alive
+        finally:
+            client.stop()
+            server.stop()
