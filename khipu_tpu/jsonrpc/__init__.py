@@ -1,6 +1,7 @@
 """JSON-RPC API (khipu-eth/.../jsonrpc/ role)."""
 
 from khipu_tpu.jsonrpc.eth_service import EthService
+from khipu_tpu.jsonrpc.personal_service import PersonalService
 from khipu_tpu.jsonrpc.server import JsonRpcServer
 
-__all__ = ["EthService", "JsonRpcServer"]
+__all__ = ["EthService", "JsonRpcServer", "PersonalService"]

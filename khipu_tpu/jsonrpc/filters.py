@@ -83,6 +83,7 @@ def get_logs(blockchain: Blockchain, query: LogQuery) -> List[LogHit]:
         body = None  # fetched lazily: only blocks with a HIT pay it
         log_index = 0
         skip_block = False
+        block_hits: List[LogHit] = []  # buffered: all-or-nothing per block
         for tx_index, receipt in enumerate(receipts):
             if skip_block:
                 break
@@ -104,7 +105,7 @@ def get_logs(blockchain: Blockchain, query: LogQuery) -> List[LogHit]:
                     if tx_index >= len(body.transactions):
                         skip_block = True
                         break
-                    hits.append(
+                    block_hits.append(
                         LogHit(
                             address=log.address,
                             topics=tuple(log.topics),
@@ -117,6 +118,8 @@ def get_logs(blockchain: Blockchain, query: LogQuery) -> List[LogHit]:
                         )
                     )
                 log_index += 1
+        if not skip_block:
+            hits.extend(block_hits)
     return hits
 
 

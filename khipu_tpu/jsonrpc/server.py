@@ -15,13 +15,17 @@ from typing import Any, Dict, List, Optional
 
 from khipu_tpu.jsonrpc.eth_service import EthService, RpcError
 
-_ALLOWED_PREFIXES = ("eth_", "net_", "web3_", "khipu_")
+_ALLOWED_PREFIXES = ("eth_", "net_", "web3_", "khipu_", "personal_")
 
 
 class JsonRpcServer:
     def __init__(self, service: EthService, host: str = "127.0.0.1",
-                 port: int = 8546):
+                 port: int = 8546, extra_services: tuple = ()):
+        """``extra_services`` are additional dispatch targets searched
+        after the primary service (PersonalService installs here —
+        JsonRpcController's per-namespace handler tables)."""
         self.service = service
+        self.services = (service, *extra_services)
         self.host = host
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -29,12 +33,23 @@ class JsonRpcServer:
 
     # ------------------------------------------------------- dispatch
 
-    def handle(self, request: Any) -> Any:
-        if isinstance(request, list):  # batch
-            return [self._handle_one(r) for r in request]
-        return self._handle_one(request)
+    # methods that sign with (or unlock) keystore keys: a webpage must
+    # never reach these through the open-CORS HTTP endpoint — any site
+    # could otherwise spend from an unlocked account (the reason geth
+    # refuses personal_* over HTTP). Browser requests carry an Origin
+    # header; curl/native tooling does not.
+    _SIGNING_METHODS = frozenset({"eth_sendTransaction", "eth_sign"})
 
-    def _handle_one(self, req: Any) -> Dict:
+    @classmethod
+    def _is_signing(cls, method: str) -> bool:
+        return method.startswith("personal_") or method in cls._SIGNING_METHODS
+
+    def handle(self, request: Any, browser_origin: bool = False) -> Any:
+        if isinstance(request, list):  # batch
+            return [self._handle_one(r, browser_origin) for r in request]
+        return self._handle_one(request, browser_origin)
+
+    def _handle_one(self, req: Any, browser_origin: bool = False) -> Dict:
         if not isinstance(req, dict):
             return {
                 "jsonrpc": "2.0", "id": None,
@@ -46,8 +61,22 @@ class JsonRpcServer:
         base = {"jsonrpc": "2.0", "id": rid}
         if not any(method.startswith(p) for p in _ALLOWED_PREFIXES):
             return {**base, "error": {"code": -32601, "message": f"method {method!r} not found"}}
-        fn = getattr(self.service, method, None)
-        if fn is None or not callable(fn):
+        if browser_origin and self._is_signing(method):
+            return {**base, "error": {
+                "code": -32601,
+                "message": "account methods are not available to "
+                "browser origins",
+            }}
+        fn = next(
+            (
+                f
+                for s in self.services
+                for f in (getattr(s, method, None),)
+                if callable(f)
+            ),
+            None,
+        )
+        if fn is None:
             return {**base, "error": {"code": -32601, "message": f"method {method!r} not found"}}
         try:
             return {**base, "result": fn(*params)}
@@ -69,7 +98,11 @@ class JsonRpcServer:
                 body = self.rfile.read(length)
                 try:
                     request = json.loads(body)
-                    response = outer.handle(request)
+                    response = outer.handle(
+                        request,
+                        browser_origin=self.headers.get("Origin")
+                        is not None,
+                    )
                 except json.JSONDecodeError:
                     response = {
                         "jsonrpc": "2.0", "id": None,

@@ -67,11 +67,34 @@ class ServiceBoard:
 
     # ---------------------------------------------------------- services
 
-    def start_rpc(self, host: str = "127.0.0.1", port: int = 8546) -> int:
+    def start_rpc(self, host: str = "127.0.0.1", port: int = 8546,
+                  key_dir: Optional[str] = None,
+                  enable_personal: bool = False) -> int:
+        """``enable_personal`` must be requested explicitly (geth's
+        --rpcapi personal posture): exposing keystore signing on an
+        HTTP endpoint is an operator decision, never a default."""
         from khipu_tpu.jsonrpc import EthService, JsonRpcServer
 
         service = EthService(self.blockchain, self.config, self.tx_pool)
-        self._rpc_server = JsonRpcServer(service, host, port)
+        extra = ()
+        keystore_dir = key_dir or (
+            os.path.join(self.config.db.data_dir, "keystore")
+            if self.config.db.data_dir
+            else None
+        )
+        if enable_personal and keystore_dir is not None:
+            from khipu_tpu.jsonrpc.personal_service import PersonalService
+            from khipu_tpu.keystore import KeyStore
+
+            extra = (
+                PersonalService(
+                    KeyStore(keystore_dir), self.blockchain,
+                    self.config, self.tx_pool,
+                ),
+            )
+        self._rpc_server = JsonRpcServer(
+            service, host, port, extra_services=extra
+        )
         return self._rpc_server.start()
 
     def start_bridge(self, host: str = "127.0.0.1", port: int = 50051,

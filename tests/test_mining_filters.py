@@ -162,6 +162,24 @@ class TestFilters:
             bc, LogQuery(3, 4, addresses=(caddr,))
         )] == [4]
 
+    def test_get_logs_truncated_body_all_or_nothing(self, chain_with_logs):
+        """A block whose stored body no longer covers its receipts
+        (mid-reorg truncation) must contribute NO hits — not a partial
+        set — while other blocks still report."""
+        bc, _, caddr = chain_with_logs
+        baseline = get_logs(bc, LogQuery(0, 4, addresses=(caddr,)))
+        assert [h.block_number for h in baseline] == [2, 4]
+        # truncate block 2's body to zero transactions
+        from khipu_tpu.domain.block import BlockBody
+
+        bc.storages.block_body_storage.put(2, BlockBody().encode())
+        hits = get_logs(bc, LogQuery(0, 4, addresses=(caddr,)))
+        assert [h.block_number for h in hits] == [4]
+        # body missing entirely: same outcome
+        bc.storages.block_body_storage.source.remove(2)
+        hits = get_logs(bc, LogQuery(0, 4, addresses=(caddr,)))
+        assert [h.block_number for h in hits] == [4]
+
     def test_eth_getLogs_rpc(self, chain_with_logs):
         bc, _, caddr = chain_with_logs
         svc = EthService(bc, CFG)

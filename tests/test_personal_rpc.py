@@ -1,0 +1,226 @@
+"""personal_* namespace: account lifecycle, message signing, and the
+keystore -> tx-pool sending path, driven through the real HTTP server.
+
+Parity: jsonrpc/PersonalService.scala:72-182.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.config import fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import SignedTransaction
+from khipu_tpu.jsonrpc import EthService, JsonRpcServer, PersonalService
+from khipu_tpu.keystore import KeyStore
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.txpool import PendingTransactionsPool
+
+PRIV = (42).to_bytes(32, "big")
+ADDR = pubkey_to_address(privkey_to_pubkey(PRIV))
+CFG = fixture_config(chain_id=1)
+
+
+@pytest.fixture
+def rpc(tmp_path):
+    bc = Blockchain(Storages(), CFG)
+    ChainBuilder(bc, CFG, GenesisSpec(alloc={ADDR: 10**21}))
+    pool = PendingTransactionsPool()
+    eth = EthService(bc, CFG, pool)
+    personal = PersonalService(
+        KeyStore(str(tmp_path / "keys")), bc, CFG, pool
+    )
+    server = JsonRpcServer(eth, extra_services=(personal,))
+    port = server.start()
+
+    calls = {}
+
+    def call(method, *params):
+        req = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "method": method,
+                "params": list(params),
+                "id": 1,
+            }
+        ).encode()
+        resp = json.loads(
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{port}",
+                    req,
+                    {"Content-Type": "application/json"},
+                )
+            ).read()
+        )
+        calls["last"] = resp
+        if "error" in resp:
+            raise RuntimeError(resp["error"]["message"])
+        return resp["result"]
+
+    call.url = f"http://127.0.0.1:{port}"
+    yield call, pool, bc
+    server.stop()
+
+
+class TestBrowserOriginGuard:
+    def test_signing_methods_rejected_for_browser_origins(self, rpc):
+        """A request carrying an Origin header (i.e. sent by a web
+        page through the open-CORS endpoint) must never reach keystore
+        signing methods."""
+        call, _, _ = rpc
+        call("personal_importRawKey", "0x" + PRIV.hex(), "pw")
+        call("personal_unlockAccount", "0x" + ADDR.hex(), "pw")
+
+        def browser_call(method, *params):
+            req = json.dumps(
+                {
+                    "jsonrpc": "2.0",
+                    "method": method,
+                    "params": list(params),
+                    "id": 1,
+                }
+            ).encode()
+            return json.loads(
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        call.url,
+                        req,
+                        {
+                            "Content-Type": "application/json",
+                            "Origin": "https://evil.example",
+                        },
+                    )
+                ).read()
+            )
+
+        for method, params in (
+            ("eth_sendTransaction", [{"from": "0x" + ADDR.hex()}]),
+            ("eth_sign", ["0x" + ADDR.hex(), "0xdead"]),
+            ("personal_unlockAccount", ["0x" + ADDR.hex(), "pw"]),
+            ("personal_listAccounts", []),
+        ):
+            resp = browser_call(method, *params)
+            assert "error" in resp, method
+            assert "browser origins" in resp["error"]["message"]
+        # non-signing methods still work for browser origins
+        assert "result" in browser_call("eth_blockNumber")
+
+    def test_unlock_duration_zero_means_indefinite(self, rpc):
+        call, _, _ = rpc
+        call("personal_importRawKey", "0x" + PRIV.hex(), "pw")
+        assert call(
+            "personal_unlockAccount", "0x" + ADDR.hex(), "pw", "0x0"
+        )
+        # still unlocked (geth: 0 = until lock/restart)
+        call("eth_sign", "0x" + ADDR.hex(), "0xdeadbeef")
+
+
+class TestPersonalAccounts:
+    def test_new_import_list_roundtrip(self, rpc):
+        call, _, _ = rpc
+        created = call("personal_newAccount", "pw1")
+        imported = call("personal_importRawKey", "0x" + PRIV.hex(), "pw2")
+        assert imported == "0x" + ADDR.hex()
+        accounts = call("personal_listAccounts")
+        assert created in accounts and imported in accounts
+
+    def test_unlock_required_and_lock(self, rpc):
+        call, _, _ = rpc
+        call("personal_importRawKey", "0x" + PRIV.hex(), "pw")
+        with pytest.raises(RuntimeError, match="locked"):
+            call("eth_sign", "0x" + ADDR.hex(), "0xdeadbeef")
+        with pytest.raises(RuntimeError, match="MAC mismatch"):
+            call("personal_unlockAccount", "0x" + ADDR.hex(), "wrong")
+        assert call("personal_unlockAccount", "0x" + ADDR.hex(), "pw")
+        call("eth_sign", "0x" + ADDR.hex(), "0xdeadbeef")  # now works
+        assert call("personal_lockAccount", "0x" + ADDR.hex())
+        with pytest.raises(RuntimeError, match="locked"):
+            call("eth_sign", "0x" + ADDR.hex(), "0xdeadbeef")
+
+
+class TestPersonalSign:
+    def test_sign_recover_roundtrip(self, rpc):
+        call, _, _ = rpc
+        call("personal_importRawKey", "0x" + PRIV.hex(), "pw")
+        sig = call("personal_sign", "0x11223344", "0x" + ADDR.hex(), "pw")
+        assert len(bytes.fromhex(sig[2:])) == 65
+        recovered = call("personal_ecRecover", "0x11223344", sig)
+        assert recovered == "0x" + ADDR.hex()
+        # a different message must NOT recover to the same address
+        other = call("personal_ecRecover", "0x55667788", sig)
+        assert other != recovered
+
+
+class TestSendTransaction:
+    def test_eth_send_transaction_roundtrip(self, rpc):
+        call, pool, bc = rpc
+        call("personal_importRawKey", "0x" + PRIV.hex(), "pw")
+        call("personal_unlockAccount", "0x" + ADDR.hex(), "pw")
+        tx_hash = call(
+            "eth_sendTransaction",
+            {
+                "from": "0x" + ADDR.hex(),
+                "to": "0x" + (b"\x99" * 20).hex(),
+                "value": hex(12345),
+            },
+        )
+        stx = pool.get(bytes.fromhex(tx_hash[2:]))
+        assert isinstance(stx, SignedTransaction)
+        # EIP-155-signed and recoverable to the unlocked account
+        assert stx.sender == ADDR
+        assert stx.tx.value == 12345
+        assert stx.tx.nonce == 0
+        # a second send advances the nonce past the pooled tx
+        tx2 = call(
+            "eth_sendTransaction",
+            {"from": "0x" + ADDR.hex(), "to": "0x" + (b"\x99" * 20).hex()},
+        )
+        assert pool.get(bytes.fromhex(tx2[2:])).tx.nonce == 1
+
+    def test_send_with_passphrase_no_unlock_needed(self, rpc):
+        call, pool, _ = rpc
+        call("personal_importRawKey", "0x" + PRIV.hex(), "pw")
+        tx_hash = call(
+            "personal_sendTransaction",
+            {"from": "0x" + ADDR.hex(), "to": "0x" + (b"\x77" * 20).hex()},
+            "pw",
+        )
+        assert pool.get(bytes.fromhex(tx_hash[2:])).sender == ADDR
+
+    def test_locked_send_rejected(self, rpc):
+        call, _, _ = rpc
+        call("personal_importRawKey", "0x" + PRIV.hex(), "pw")
+        with pytest.raises(RuntimeError, match="locked"):
+            call(
+                "eth_sendTransaction",
+                {"from": "0x" + ADDR.hex(), "to": "0x" + ("11" * 20)},
+            )
+
+    def test_sent_tx_is_minable(self, rpc):
+        """The pooled tx executes in a real block (keystore -> pool ->
+        chain round-trip)."""
+        call, pool, bc = rpc
+        call("personal_importRawKey", "0x" + PRIV.hex(), "pw")
+        call("personal_unlockAccount", "0x" + ADDR.hex(), "pw")
+        dest = b"\x99" * 20
+        call(
+            "eth_sendTransaction",
+            {
+                "from": "0x" + ADDR.hex(),
+                "to": "0x" + dest.hex(),
+                "value": hex(10**18),
+                "gas": hex(21000),
+            },
+        )
+        builder = ChainBuilder.from_head(bc, CFG)
+        block = builder.add_block(pool.pending(), coinbase=b"\xaa" * 20)
+        assert len(block.body.transactions) == 1
+        acc = bc.get_account(dest, block.header.state_root)
+        assert acc.balance == 10**18
