@@ -190,27 +190,28 @@ def bench_bulk_build():
 
 def bench_snapshot_verify(N=1 << 20, L=576):
     """Config #5 (single-chip form): content-address verification rate —
-    re-hash N nodes and compare to claimed keys, all device-resident."""
+    re-hash N nodes and compare to claimed keys, all device-resident
+    (u32 word planes end to end; the node store's device mirror keeps
+    packed words, so no byte-granular layout op appears on the hot
+    path)."""
     import jax
     import jax.numpy as jnp
 
-    from khipu_tpu.ops.keccak_pallas import _build_device_fixed
+    from khipu_tpu.ops.keccak_pallas import _build_device_fixed_words
 
-    run = _build_device_fixed(L, False)
+    run = _build_device_fixed_words(L, False)
     base = jax.random.bits(jax.random.PRNGKey(7), (N, L // 4), jnp.uint32)
 
     @jax.jit
     def hash_only(words, salt):
-        data = jax.lax.bitcast_convert_type(words ^ salt, jnp.uint8).reshape(N, L)
-        return run(data)
+        return run(words ^ salt)
 
     @jax.jit
     def verify(words, salt, claimed):
         # claimed is an INPUT (precomputed in a separate dispatch), so
         # the comparison cannot be constant-folded and the kernel stays
         # live in the timed graph
-        data = jax.lax.bitcast_convert_type(words ^ salt, jnp.uint8).reshape(N, L)
-        digests = run(data)
+        digests = run(words ^ salt)
         return jnp.sum(jnp.any(digests != claimed, axis=1))
 
     claims = {
@@ -248,21 +249,20 @@ def bench_keccak_primary():
     import numpy as np
 
     from khipu_tpu.base.crypto.keccak import keccak256
-    from khipu_tpu.ops.keccak_pallas import _build_device_fixed
+    from khipu_tpu.ops.keccak_pallas import _build_device_fixed_words
 
     N, L, ROUNDS = 1 << 20, 576, 8
-    run = _build_device_fixed(L, False)
+    run = _build_device_fixed_words(L, False)
     base = jax.random.bits(jax.random.PRNGKey(2026), (N, L // 4), jnp.uint32)
 
     @jax.jit
     def one(words, salt):
-        data = jax.lax.bitcast_convert_type(words ^ salt, jnp.uint8).reshape(N, L)
-        return data, run(data)
+        return run(words ^ salt)
 
     # correctness gate: a wrong kernel benches at zero
-    data0, digests = one(base, jnp.uint32(0))
-    rows = np.asarray(jax.device_get(data0[:4]))
-    outs = np.asarray(jax.device_get(digests[:4]))
+    digests = one(base, jnp.uint32(0))
+    rows = np.asarray(jax.device_get(base[:4])).astype("<u4")
+    outs = np.asarray(jax.device_get(digests[:4])).astype("<u4")
     for i in range(4):
         assert outs[i].tobytes() == keccak256(rows[i].tobytes()), "kernel mismatch"
 
@@ -270,12 +270,9 @@ def bench_keccak_primary():
     def step(words, salt0):
         def body(i, carry):
             acc, salt = carry
-            data = jax.lax.bitcast_convert_type(
-                words ^ salt, jnp.uint8
-            ).reshape(N, L)
-            return acc ^ run(data), salt + jnp.uint32(1)
+            return acc ^ run(words ^ salt), salt + jnp.uint32(1)
         acc, _ = jax.lax.fori_loop(
-            0, ROUNDS, body, (jnp.zeros((N, 32), jnp.uint8), salt0)
+            0, ROUNDS, body, (jnp.zeros((N, 8), jnp.uint32), salt0)
         )
         return acc
 

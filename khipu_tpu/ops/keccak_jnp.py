@@ -146,17 +146,30 @@ def pad_to_blocks(messages: Sequence[bytes], nblocks: int) -> np.ndarray:
     (i.e. nblocks = len(m)//RATE + 1). Returns uint32[nblocks, 34, B].
     """
     batch = len(messages)
+    buf32 = pad_to_words(messages, nblocks)
+    # -> (nblocks, 34, B)
+    return np.ascontiguousarray(
+        buf32.reshape(batch, nblocks, 34).transpose(1, 2, 0)
+    )
+
+
+def pad_to_words(messages: Sequence[bytes], nblocks: int) -> np.ndarray:
+    """Host-side multi-rate padding in the batch-major layout the
+    device words path consumes directly: uint32[B, nblocks*34]. No
+    host transpose — the word-major retile happens on device where it
+    runs near HBM bandwidth."""
+    batch = len(messages)
     buf = np.zeros((batch, nblocks * RATE), dtype=np.uint8)
     for j, m in enumerate(messages):
         if len(m) // RATE + 1 != nblocks:
-            raise ValueError(f"message {j} needs {len(m)//RATE + 1} blocks, class is {nblocks}")
+            raise ValueError(
+                f"message {j} needs {len(m)//RATE + 1} blocks, "
+                f"class is {nblocks}"
+            )
         buf[j, : len(m)] = np.frombuffer(m, dtype=np.uint8)
         buf[j, len(m)] ^= 0x01
         buf[j, nblocks * RATE - 1] ^= 0x80
-    # little-endian u32 view: word w of message j = buf32[j, w]
-    buf32 = buf.view("<u4")  # (B, nblocks*34)
-    # -> (nblocks, 34, B)
-    return np.ascontiguousarray(buf32.reshape(batch, nblocks, 34).transpose(1, 2, 0))
+    return buf.view("<u4")  # (B, nblocks*34)
 
 
 def digests_to_bytes(words: np.ndarray) -> List[bytes]:

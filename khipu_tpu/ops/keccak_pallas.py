@@ -7,9 +7,12 @@ never touches HBM. This is the TPU replacement for the reference's
 scalar JVM sponge hot loop (khipu-base/.../crypto/hash/KeccakCore.scala
 invoked per trie node at trie/Node.scala:111-112).
 
-Input layout (host-packed by khipu_tpu.ops.keccak_jnp.pad_to_blocks and
-retiled here): uint32[tiles, nblocks*34, 8, 128] — word-major, batch in
-the (sublane, lane) dims. Output: uint32[tiles, 8, 8, 128] digest words.
+Kernel input layout: uint32[tiles, nwords, 8, 128] — word-major planes,
+batch in the (sublane, lane) dims. Callers ship batch-major
+uint32[N, nwords] (host-packed by keccak_jnp.pad_to_words, or generated
+on device) and the retile to word-major runs on device near HBM
+bandwidth; the multi-rate pad is fused into the kernel for fixed-size
+classes. Output: uint32[tiles, 8, 8, 128] digest words.
 """
 
 from __future__ import annotations
@@ -28,13 +31,30 @@ from khipu_tpu.ops.keccak_jnp import (
     LANES_PER_BLOCK,
     RATE,
     pad_batch_count,
-    pad_to_blocks,
+    pad_to_words,
 )
 
 TILE = 8 * 128  # messages per grid step
 
 
-def _make_kernel(nblocks: int):
+def _make_kernel(nblocks: int, nwords_in: int = None):
+    """Sponge kernel over word-major planes.
+
+    With ``nwords_in`` set, the input carries only the message words and
+    the multi-rate padding is fused: pad words are per-size-class
+    constants (0x01 right after the message, 0x80 in the last byte), so
+    they xor into the state in registers instead of being materialized
+    as an HBM concatenate (roofline attack plan item 2).
+    """
+    total_words = nblocks * 2 * LANES_PER_BLOCK
+    if nwords_in is None:
+        nwords_in = total_words
+    pad_words = {}
+    if nwords_in < total_words:
+        pad_words[nwords_in] = 0x00000001
+        last = total_words - 1
+        pad_words[last] = pad_words.get(last, 0) | 0x80000000
+
     def kernel(blocks_ref, out_ref):
         zero = jnp.zeros((8, 128), jnp.uint32)
         lo: List = [zero] * 25
@@ -42,8 +62,12 @@ def _make_kernel(nblocks: int):
         for b in range(nblocks):
             base = b * 2 * LANES_PER_BLOCK
             for i in range(LANES_PER_BLOCK):
-                lo[i] = lo[i] ^ blocks_ref[0, base + 2 * i]
-                hi[i] = hi[i] ^ blocks_ref[0, base + 2 * i + 1]
+                for half, st in ((0, lo), (1, hi)):
+                    w = base + 2 * i + half
+                    if w < nwords_in:
+                        st[i] = st[i] ^ blocks_ref[0, w]
+                    if w in pad_words:
+                        st[i] = st[i] ^ jnp.uint32(pad_words[w])
             for rc_lo, rc_hi in _RC32:
                 lo, hi = _round(lo, hi, jnp.uint32(rc_lo), jnp.uint32(rc_hi))
         for k in range(4):
@@ -54,14 +78,21 @@ def _make_kernel(nblocks: int):
 
 
 @functools.lru_cache(maxsize=32)
-def _build(nblocks: int, interpret: bool):
-    nwords = nblocks * 2 * LANES_PER_BLOCK
+def _build(nblocks: int, interpret: bool, nwords_in: int = None):
+    """Compile the sponge for ``nblocks`` rate blocks. With
+    ``nwords_in``, input planes carry only the message words and the
+    pad is fused in-kernel."""
+    nwords = (
+        nwords_in
+        if nwords_in is not None
+        else nblocks * 2 * LANES_PER_BLOCK
+    )
 
     @jax.jit
     def run(blocks):  # uint32[tiles, nwords, 8, 128]
         tiles = blocks.shape[0]
         return pl.pallas_call(
-            _make_kernel(nblocks),
+            _make_kernel(nblocks, nwords_in),
             grid=(tiles,),
             in_specs=[
                 pl.BlockSpec((1, nwords, 8, 128), lambda i: (i, 0, 0, 0))
@@ -102,14 +133,81 @@ def _build_from_bytes(nblocks: int, interpret: bool):
     return go
 
 
+def _words_runner(nblocks: int, interpret: bool, nwords_in: int = None):
+    """u32-native full path: batch-major words -> digest words.
+
+    The byte-granular path (`_build_from_bytes`) costs ~4x the sponge
+    itself in pure HBM relayout (u8 tiling is (32, 128); every
+    reshape/bitcast across the u8/u32 boundary is a gather). Staying in
+    u32 end to end, the only layout op left is the word-major tile
+    transpose, which XLA runs near memory bandwidth. With ``nwords_in``
+    the input carries message words only and the pad is fused
+    in-kernel.
+    """
+    nwords = (
+        nwords_in
+        if nwords_in is not None
+        else nblocks * 2 * LANES_PER_BLOCK
+    )
+    run = _build(nblocks, interpret, nwords_in=nwords_in)
+
+    @jax.jit
+    def go(words):  # uint32[N, nwords], N % TILE == 0
+        n = words.shape[0]
+        tiles = n // TILE
+        tiled = words.reshape(tiles, 8, 128, nwords).transpose(0, 3, 1, 2)
+        out = run(tiled)  # (tiles, 8, 8, 128)
+        return out.transpose(0, 2, 3, 1).reshape(n, 8)  # digest words
+
+    return go
+
+
+@functools.lru_cache(maxsize=32)
+def _build_from_words(nblocks: int, interpret: bool):
+    """Already-padded batch-major words -> digest words."""
+    return _words_runner(nblocks, interpret)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_device_fixed_words(length: int, interpret: bool):
+    """Device-resident full path for fixed-size messages given as u32
+    words: retile + sponge with the multi-rate pad fused in-kernel (no
+    HBM pad materialization at all). uint32[N, length//4] ->
+    uint32[N, 8] digest words. Requires length % 4 == 0.
+    """
+    if length % 4:
+        raise ValueError("u32 path requires length % 4 == 0")
+    nblocks = length // RATE + 1
+    return _words_runner(nblocks, interpret, nwords_in=length // 4)
+
+
 @functools.lru_cache(maxsize=32)
 def _build_device_fixed(length: int, interpret: bool):
     """Fully device-resident: pad + pack + hash uint8[N, length] on device.
 
     No host round-trip: use when the node bytes already live on device
     (or are generated there, as in the microbench). Returns uint8[N, 32].
+    For length % 4 == 0 the words path (`_build_device_fixed_words`)
+    avoids every u8-granular layout op; this wrapper only pays one
+    bitcast at each edge.
     """
     nblocks = length // RATE + 1
+    if length % 4 == 0:
+        run_words = _build_device_fixed_words(length, interpret)
+
+        @jax.jit
+        def go(data_u8):  # uint8[N, length], N % TILE == 0
+            n = data_u8.shape[0]
+            words = jax.lax.bitcast_convert_type(
+                data_u8.reshape(n, length // 4, 4), jnp.uint32
+            )
+            digest = run_words(words)
+            return jax.lax.bitcast_convert_type(digest, jnp.uint8).reshape(
+                n, 32
+            )
+
+        return go
+
     run_bytes = _build_from_bytes(nblocks, interpret)
 
     @jax.jit
@@ -130,8 +228,8 @@ def keccak256_fixed(
     """Hash N equal-length messages: uint8[N, L] -> uint8[N, 32].
 
     The bulk-commit fast path (all dirty trie nodes of one size class in
-    one device call). Pads on host (vectorized), packs and hashes on
-    device.
+    one device call). Pads on host (vectorized), ships batch-major u32
+    words, retiles + hashes on device (no byte-granular device op).
     """
     n, length = data.shape
     nblocks = length // RATE + 1
@@ -145,8 +243,11 @@ def keccak256_fixed(
         extra[:, length] ^= 0x01
         extra[:, nblocks * RATE - 1] ^= 0x80
         padded = np.concatenate([padded, extra], axis=0)
-    out = _build_from_bytes(nblocks, interpret)(jnp.asarray(padded))
-    return np.asarray(jax.device_get(out))[:n]
+    out = _build_from_words(nblocks, interpret)(
+        jnp.asarray(padded.view("<u4"))
+    )
+    digest_words = np.asarray(jax.device_get(out), dtype="<u4")[:n]
+    return digest_words.view(np.uint8).reshape(n, 32)
 
 
 def retile(blocks: np.ndarray) -> np.ndarray:
@@ -190,20 +291,14 @@ def keccak256_batch_pallas(
     from khipu_tpu.ops.keccak_jnp import bucketed_batch
 
     def run_bucket(nblocks, msgs):
-        packed = pad_to_blocks(msgs, nblocks)
-        tiled = retile(packed)
-        run = _build(nblocks, interpret)
+        packed = pad_to_words(msgs, nblocks)  # (B, nwords) batch-major
+        run = _build_from_words(nblocks, interpret)
+        rows_per_chunk = MAX_TILES * TILE
         chunks = []
-        for start in range(0, tiled.shape[0], MAX_TILES):
-            words = run(jnp.asarray(tiled[start : start + MAX_TILES]))
+        for start in range(0, packed.shape[0], rows_per_chunk):
+            words = run(jnp.asarray(packed[start : start + rows_per_chunk]))
             chunks.append(np.asarray(jax.device_get(words), dtype="<u4"))
-        arr = np.concatenate(chunks, axis=0)  # (tiles, 8, 8, 128)
-        # invert retile: digest j is at [j//1024, :, (j%1024)//128, j%128]
-        digests = []
-        for pos in range(len(msgs)):
-            t, r = divmod(pos, TILE)
-            sub, lane = divmod(r, 128)
-            digests.append(arr[t, :, sub, lane].tobytes())
-        return digests
+        arr = np.concatenate(chunks, axis=0)  # (B, 8) digest words
+        return [arr[j].tobytes() for j in range(len(msgs))]
 
     return bucketed_batch(messages, _pallas_target_count, run_bucket)
