@@ -1,0 +1,101 @@
+"""VM backend dispatch: native C++ core when available, Python fallback.
+
+The ledger calls `run_message_call` / `run_create` instead of binding
+directly to evm.vm; each call picks the backend. The native core is
+skipped when:
+  * the shared library didn't build (no toolchain) — Python fallback;
+  * an opcode trace is active (debug-trace-at hooks the Python loop);
+  * the frame gas exceeds the native int64 budget (never on real chains);
+  * the backend is forced via set_backend / KHIPU_VM_BACKEND=python.
+
+Both backends produce identical ProgramResults and identical world
+write-log / read-set effects (tests/test_native_evm.py runs the
+differential suite).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from khipu_tpu.evm import vm as pyvm
+from khipu_tpu.evm.config import EvmConfig
+from khipu_tpu.evm.vm import MessageEnv, ProgramResult
+from khipu_tpu.evm import native_vm
+
+_FORCED: Optional[str] = None  # None=auto | "python" | "native"
+
+
+def set_backend(name: Optional[str]) -> None:
+    """Force a backend ("python" / "native") or None for auto."""
+    global _FORCED
+    _FORCED = name
+
+
+def use_native(gas: int) -> bool:
+    forced = _FORCED or os.environ.get("KHIPU_VM_BACKEND")
+    if forced == "python":
+        return False
+    if pyvm._TRACE is not None:  # opcode tracing hooks the Python loop
+        return False
+    ok = native_vm.available() and gas < native_vm.MAX_NATIVE_GAS
+    if forced == "native" and not ok:
+        raise RuntimeError("native VM backend forced but unavailable")
+    return ok
+
+
+def run_message_call(
+    config: EvmConfig,
+    world,
+    block,
+    env: MessageEnv,
+    code: bytes,
+    gas: int,
+    code_address: bytes,
+    pre_transfer: bool = False,
+) -> ProgramResult:
+    """Top-level message call (execute_transaction's CALL path).
+
+    ``pre_transfer``: apply the tx-level value transfer + target touch
+    inside the frame (so it rolls back with the frame). The Python path
+    applies it to a world copy exactly like ledger.py always did; the
+    native path emits it into the frame's op log.
+    """
+    if use_native(gas):
+        return native_vm.native_execute_message(
+            config, world, block, env, code, gas, code_address,
+            pre_transfer=pre_transfer,
+        )
+    target = world
+    if pre_transfer:
+        target = world.copy()
+        target.transfer(env.caller, env.owner, env.value)
+        target.touch(env.owner)
+    return pyvm._execute_message(
+        config, target, block, env, code, gas, code_address
+    )
+
+
+def run_create(
+    config: EvmConfig,
+    world,
+    block,
+    caller: bytes,
+    origin: bytes,
+    new_addr: bytes,
+    gas: int,
+    gas_price: int,
+    value: int,
+    init_code: bytes,
+    depth: int,
+) -> Tuple[ProgramResult, bytes]:
+    """Top-level contract creation (execute_transaction's CREATE path)."""
+    if use_native(gas):
+        return native_vm.native_create_contract(
+            config, world, block, caller, origin, new_addr, gas,
+            gas_price, value, init_code, depth,
+        )
+    return pyvm.create_contract(
+        config, world, block, caller, origin, new_addr, gas, gas_price,
+        value, init_code, depth,
+    )

@@ -44,12 +44,8 @@ from khipu_tpu.domain.block import Block
 from khipu_tpu.domain.receipt import Receipt, TxLogEntry
 from khipu_tpu.domain.transaction import SignedTransaction, contract_address
 from khipu_tpu.evm.config import EvmConfig, for_block
-from khipu_tpu.evm.vm import (
-    BlockEnv,
-    MessageEnv,
-    _execute_message,
-    create_contract,
-)
+from khipu_tpu.evm.dispatch import run_create, run_message_call
+from khipu_tpu.evm.vm import BlockEnv, MessageEnv
 from khipu_tpu.ledger.bloom import bloom_of_logs, bloom_union
 from khipu_tpu.ledger.rewards import block_rewards
 from khipu_tpu.ledger.world import BlockWorldState
@@ -171,14 +167,11 @@ def execute_transaction(
     checkpoint = world.copy()
     if tx.is_contract_creation:
         new_addr = contract_address(sender, tx.nonce)
-        result, _ = create_contract(
+        result, _ = run_create(
             config, world, block_env, sender, sender, new_addr, gas,
             gas_price, tx.value, tx.payload, depth=0,
         )
     else:
-        child = world.copy()
-        child.transfer(sender, tx.to, tx.value)
-        child.touch(tx.to)
         env = MessageEnv(
             owner=tx.to,
             caller=sender,
@@ -188,8 +181,9 @@ def execute_transaction(
             input_data=tx.payload,
             depth=0,
         )
-        result = _execute_message(
-            config, child, block_env, env, world.get_code(tx.to), gas, tx.to
+        result = run_message_call(
+            config, world, block_env, env, world.get_code(tx.to), gas,
+            tx.to, pre_transfer=True,
         )
 
     if result.error is not None:

@@ -17,12 +17,8 @@ from khipu_tpu.domain.block_header import BlockHeader
 from khipu_tpu.domain.receipt import TxLogEntry
 from khipu_tpu.domain.transaction import contract_address
 from khipu_tpu.evm.config import for_block
-from khipu_tpu.evm.vm import (
-    BlockEnv,
-    MessageEnv,
-    _execute_message,
-    create_contract,
-)
+from khipu_tpu.evm.dispatch import run_create, run_message_call
+from khipu_tpu.evm.vm import BlockEnv, MessageEnv
 
 ZERO_ADDRESS = b"\x00" * 20
 
@@ -72,21 +68,22 @@ def simulate_call(
     if to is None:
         nonce = world.get_nonce(sender)
         world.increase_nonce(sender)
-        result, _ = create_contract(
+        result, _ = run_create(
             config, world, block_env, sender, sender,
             contract_address(sender, nonce), exec_gas, gas_price, value,
             data, depth=0,
         )
     else:
-        child = world.copy()
-        if world.get_balance(sender) >= value:
-            child.transfer(sender, to, value)
         env = MessageEnv(
             owner=to, caller=sender, origin=sender,
             gas_price=gas_price, value=value, input_data=data,
         )
-        result = _execute_message(
-            config, child, block_env, env, world.get_code(to), exec_gas, to
+        # relaxed-balance rule: only transfer when covered (the world is
+        # discarded afterwards, so backend write targets don't matter)
+        do_transfer = world.get_balance(sender) >= value
+        result = run_message_call(
+            config, world, block_env, env, world.get_code(to), exec_gas,
+            to, pre_transfer=do_transfer,
         )
     gas_used = gas - result.gas_remaining if result.error is None else gas
     return CallResult(

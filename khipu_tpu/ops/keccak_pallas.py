@@ -77,11 +77,19 @@ def _make_kernel(nblocks: int, nwords_in: int = None):
     return kernel
 
 
-@functools.lru_cache(maxsize=32)
 def _build(nblocks: int, interpret: bool, nwords_in: int = None):
     """Compile the sponge for ``nblocks`` rate blocks. With
     ``nwords_in``, input planes carry only the message words and the
-    pad is fused in-kernel."""
+    pad is fused in-kernel. Normalizes the default BEFORE memoizing so
+    `_build(n, i)` and `_build(n, i, nwords_in=full)` share one compile."""
+    full = nblocks * 2 * LANES_PER_BLOCK
+    if nwords_in is not None and nwords_in >= full:
+        nwords_in = None
+    return _build_cached(nblocks, interpret, nwords_in)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_cached(nblocks: int, interpret: bool, nwords_in):
     nwords = (
         nwords_in
         if nwords_in is not None
