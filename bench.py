@@ -51,7 +51,8 @@ def cpu_scalar_baseline(length: int = 576, iters: int = 20000) -> float:
     return iters / (time.perf_counter() - t0)
 
 
-def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1):
+def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
+                 note=None):
     """Configs #1/#4: build a fixture chain, then time a validated
     replay into a fresh chain DB with device trie commits (windowed:
     one batched device pass per `window` blocks)."""
@@ -132,6 +133,134 @@ def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1):
         window=window,
         n_blocks=n_blocks,
         txs_per_block=txs_per_block,
+        **({"note": note} if note else {}),
+    )
+
+
+def bench_replay_contended(n_blocks=8, txs_per_block=50, hot_recipients=4,
+                           hot_fraction=0.2, window=8):
+    """Config #4 adversarial variant: ERC-20-style token transfers with
+    CONTENDED storage slots, so the optimistic-parallel merge actually
+    detects conflicts and re-executes (the disjoint-transfer variant
+    above measures the best case only). A `hot_fraction` of each block's
+    txs pays one of `hot_recipients` shared addresses — every later tx
+    touching a hot balance slot reads what an earlier tx wrote and must
+    re-run serially (Ledger.scala:393-434 path). Token bytecode runs on
+    the native EVM when built."""
+    import dataclasses
+
+    from khipu_tpu.base.crypto.secp256k1 import (
+        privkey_to_pubkey,
+        pubkey_to_address,
+    )
+    from khipu_tpu.config import SyncConfig, fixture_config
+    from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+    from khipu_tpu.domain.transaction import (
+        Transaction,
+        contract_address,
+        sign_transaction,
+    )
+    from khipu_tpu.storage.storages import Storages
+    from khipu_tpu.sync.chain_builder import ChainBuilder
+    from khipu_tpu.sync.replay import ReplayDriver
+    from khipu_tpu.domain.block import Block as _Block
+
+    cfg = fixture_config(chain_id=1)
+    cfg = dataclasses.replace(
+        cfg,
+        sync=SyncConfig(
+            parallel_tx=True, tx_workers=8, commit_window_blocks=window,
+        ),
+    )
+    nsenders = txs_per_block  # one tx per sender per block: distinct nonces
+    keys = [(i + 101).to_bytes(32, "big") for i in range(nsenders)]
+    addrs = [pubkey_to_address(privkey_to_pubkey(k)) for k in keys]
+    alloc = {a: 10**24 for a in addrs}
+
+    # token runtime: balance[CALLER] -= amt; balance[to] += amt
+    # (wrapping — contention shape is the point, not ERC-20 semantics)
+    runtime = bytes(
+        [
+            0x60, 0x00, 0x35,        # PUSH1 0 CALLDATALOAD    .. to
+            0x60, 0x20, 0x35,        # PUSH1 32 CALLDATALOAD   .. to amt
+            0x33, 0x54,              # CALLER SLOAD            .. to amt bal_c
+            0x81, 0x90, 0x03,        # DUP2 SWAP1 SUB          .. to amt bal_c-amt
+            0x33, 0x55,              # CALLER SSTORE           .. to amt
+            0x81, 0x54, 0x01,        # DUP2 SLOAD ADD          .. to bal_to+amt
+            0x90, 0x55,              # SWAP1 SSTORE[to]        .. (empty)
+            0x00,                    # STOP
+        ]
+    )
+    init = (
+        bytes([0x60 + len(runtime) - 1]) + runtime
+        + bytes([0x60, 0x00, 0x52])
+        + bytes([0x60, len(runtime), 0x60, 32 - len(runtime), 0xF3])
+    )
+
+    builder = ChainBuilder(
+        Blockchain(Storages(), cfg), cfg, GenesisSpec(alloc=alloc)
+    )
+    blocks = [
+        builder.add_block(
+            [sign_transaction(
+                Transaction(0, 10**9, 500_000, None, 0, payload=init),
+                keys[0], chain_id=1,
+            )],
+            coinbase=b"\xaa" * 20,
+        )
+    ]
+    token = contract_address(addrs[0], 0)
+    hot = [
+        bytes.fromhex("%040x" % (0xA0000000 + i))
+        for i in range(hot_recipients)
+    ]
+    cold = [bytes.fromhex("%040x" % (0xB0000000 + i)) for i in range(4096)]
+    nonces = [1] + [0] * (nsenders - 1)
+    n_hot = max(1, int(txs_per_block * hot_fraction))
+    for n in range(n_blocks):
+        txs = []
+        for j in range(txs_per_block):
+            if j < n_hot:
+                to = hot[(j + n) % hot_recipients]
+            else:
+                to = cold[(n * txs_per_block + j * 13) % len(cold)]
+            payload = to.rjust(32, b"\x00") + (1).to_bytes(32, "big")
+            txs.append(
+                sign_transaction(
+                    Transaction(
+                        nonces[j], 10**9, 200_000, token, 0, payload=payload
+                    ),
+                    keys[j],
+                    chain_id=1,
+                )
+            )
+            nonces[j] += 1
+        blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
+
+    wire = [b.encode() for b in blocks]
+    blocks = [_Block.decode(w) for w in wire]
+    target = Blockchain(Storages(), cfg)
+    target.load_genesis(GenesisSpec(alloc=alloc))
+    # host commit: this metric isolates parallel-execution + merge cost
+    # under contention (the windowed device-commit cost is the previous
+    # metric's job); device_commit here would drown it in tunnel latency
+    driver = ReplayDriver(target, cfg, device_commit=False)
+    stats = driver.replay(blocks)
+    from khipu_tpu.evm.native_vm import available as native_available
+
+    emit(
+        "replay_contended_erc20_blocks_per_sec",
+        round(stats.blocks_per_s, 2),
+        "blocks/s",
+        txs=stats.txs,
+        parallel_pct=round(
+            100 * stats.parallel_txs / stats.txs if stats.txs else 0
+        ),
+        conflicts=stats.conflicts,
+        hot_recipients=hot_recipients,
+        hot_fraction=hot_fraction,
+        window=window,
+        native_evm=native_available(),
     )
 
 
@@ -297,11 +426,16 @@ def main() -> None:
     bench_replay(
         120, 3, "replay_early_era_fixture_blocks_per_sec",
         parallel=False, window=40,
+        note=(
+            "byzantium-SHAPED fixture blocks (the windowed pipeline needs "
+            "status receipts); true pre-Byzantium eras force window=1"
+        ),
     )
     bench_replay(
         8, 50, "replay_parallel_commit_fixture_blocks_per_sec",
         parallel=True, window=8,
     )
+    bench_replay_contended()
     bench_bulk_build()
     bench_snapshot_verify()
     bench_keccak_primary()  # primary metric: keep LAST

@@ -1626,9 +1626,11 @@ static RunResult run_frame(TxCtx& tx, const MsgEnv& env, const uint8_t* code,
           uint64_t dst = sat_u64(dst_w), src = sat_u64(src_w),
                    size = sat_u64(size_w);
           f.mem.expand(dst, size);
+          // avail guards src+i wraparound at src near 2^64 (zero-pad
+          // region, matching vm.py _zero_slice)
+          uint64_t avail = src < f.input_len ? f.input_len - src : 0;
           for (uint64_t i = 0; i < size; ++i)
-            f.mem.data[dst + i] =
-                (src + i < f.input_len) ? f.input[src + i] : 0;
+            f.mem.data[dst + i] = (i < avail) ? f.input[src + i] : 0;
           f.pc += 1;
           break;
         }
@@ -1647,8 +1649,9 @@ static RunResult run_frame(TxCtx& tx, const MsgEnv& env, const uint8_t* code,
           uint64_t dst = sat_u64(dst_w), src = sat_u64(src_w),
                    size = sat_u64(size_w);
           f.mem.expand(dst, size);
+          uint64_t avail = src < f.code_len ? f.code_len - src : 0;
           for (uint64_t i = 0; i < size; ++i)
-            f.mem.data[dst + i] = (src + i < f.code_len) ? f.code[src + i] : 0;
+            f.mem.data[dst + i] = (i < avail) ? f.code[src + i] : 0;
           f.pc += 1;
           break;
         }
@@ -1681,8 +1684,9 @@ static RunResult run_frame(TxCtx& tx, const MsgEnv& env, const uint8_t* code,
           const uint8_t* p = nullptr;
           uint64_t n = 0;
           r_code(tx, a, &p, &n);
+          uint64_t avail = src < n ? n - src : 0;
           for (uint64_t i = 0; i < size; ++i)
-            f.mem.data[dst + i] = (src + i < n) ? p[src + i] : 0;
+            f.mem.data[dst + i] = (i < avail) ? p[src + i] : 0;
           f.pc += 1;
           break;
         }

@@ -235,6 +235,25 @@ class TestMessageDifferential:
         )
         assert_same(code, input_data=bytes(range(1, 30)))
 
+    def test_copy_src_offset_wraparound(self):
+        # src near 2^64 must zero-pad, not wrap src+i back into the
+        # buffer (consensus-divergence regression: u64 overflow guard)
+        huge = (1 << 64) - 1
+        for copy_op in (0x37, 0x39):  # CALLDATACOPY, CODECOPY
+            code = asm(
+                push(4), push(huge, 8), push(0), copy_op,
+                push(2), push(1 << 200, 26), push(8), copy_op,
+                push(32), push(0), 0xF3,
+            )
+            assert_same(code, input_data=b"\xab" * 64)
+        other = b"\x29" * 20
+        code = asm(
+            push(4), push(huge, 8), push(0),
+            push(int.from_bytes(other, "big"), 20), 0x3C,  # EXTCODECOPY
+            push(32), push(0), 0xF3,
+        )
+        assert_same(code, setup=lambda w: _deploy(w, other, b"\xcd" * 40))
+
     def test_blockhash_oob(self):
         code = asm(push(0), 0x40, push(500), 0x40, 0x01, push(0), 0x52,
                    push(32), push(0), 0xF3)
