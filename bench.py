@@ -51,20 +51,27 @@ def cpu_scalar_baseline(length: int = 576, iters: int = 20000) -> float:
     return iters / (time.perf_counter() - t0)
 
 
-def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
-                 note=None):
-    """Configs #1/#4: build a fixture chain, then time a validated
-    replay into a fresh chain DB with device trie commits (windowed:
-    one batched device pass per `window` blocks)."""
-    import dataclasses
-
+def _replay_keys(nsenders, seed_base=1):
     from khipu_tpu.base.crypto.secp256k1 import (
         privkey_to_pubkey,
         pubkey_to_address,
     )
+
+    keys = [(i + seed_base).to_bytes(32, "big") for i in range(nsenders)]
+    addrs = [pubkey_to_address(privkey_to_pubkey(k)) for k in keys]
+    return keys, addrs
+
+
+def _replay_fixture(parallel, window, alloc, build_blocks, device_commit):
+    """Shared replay-bench scaffolding: build a fixture chain through the
+    ChainBuilder, round-trip through wire RLP (replay must pay sender
+    recovery + parse like a real sync), then replay into a fresh chain
+    DB. ``build_blocks(builder)`` returns the block list."""
+    import dataclasses
+
     from khipu_tpu.config import SyncConfig, fixture_config
+    from khipu_tpu.domain.block import Block as _Block
     from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
-    from khipu_tpu.domain.transaction import Transaction, sign_transaction
     from khipu_tpu.storage.storages import Storages
     from khipu_tpu.sync.chain_builder import ChainBuilder
     from khipu_tpu.sync.replay import ReplayDriver
@@ -77,50 +84,58 @@ def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
             commit_window_blocks=window,
         ),
     )
+    builder = ChainBuilder(
+        Blockchain(Storages(), cfg), cfg, GenesisSpec(alloc=alloc)
+    )
+    blocks = [_Block.decode(b.encode()) for b in build_blocks(builder)]
+    target = Blockchain(Storages(), cfg)
+    target.load_genesis(GenesisSpec(alloc=alloc))
+    driver = ReplayDriver(target, cfg, device_commit=device_commit)
+    return driver.replay(blocks)
+
+
+def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
+                 note=None):
+    """Configs #1/#4: build a fixture chain, then time a validated
+    replay into a fresh chain DB with device trie commits (windowed:
+    one batched device pass per `window` blocks)."""
+    from khipu_tpu.domain.transaction import Transaction, sign_transaction
+
     nsenders = min(max(txs_per_block, 2), 64)
-    keys = [(i + 1).to_bytes(32, "big") for i in range(nsenders)]
-    addrs = [pubkey_to_address(privkey_to_pubkey(k)) for k in keys]
+    keys, addrs = _replay_keys(nsenders)
     # receivers are a DISJOINT address pool: typical blocks pay
     # addresses that are not also senders in the same block, which is
     # what makes the reference's ~80% parallel rate achievable
     receivers = [
         bytes.fromhex("%040x" % (0xBEEF0000 + i)) for i in range(256)
     ]
-    alloc = {a: 10**24 for a in addrs}
 
-    builder = ChainBuilder(
-        Blockchain(Storages(), cfg), cfg, GenesisSpec(alloc=alloc)
-    )
-    blocks = []
-    nonces = [0] * nsenders
-    for n in range(n_blocks):
-        txs = []
-        for j in range(txs_per_block):
-            i = j % nsenders
-            txs.append(
-                sign_transaction(
-                    Transaction(
-                        nonces[i], 10**9, 21_000,
-                        receivers[(j * 7 + n) % len(receivers)], 1_000 + n,
-                    ),
-                    keys[i],
-                    chain_id=1,
+    def build(builder):
+        blocks = []
+        nonces = [0] * nsenders
+        for n in range(n_blocks):
+            txs = []
+            for j in range(txs_per_block):
+                i = j % nsenders
+                txs.append(
+                    sign_transaction(
+                        Transaction(
+                            nonces[i], 10**9, 21_000,
+                            receivers[(j * 7 + n) % len(receivers)],
+                            1_000 + n,
+                        ),
+                        keys[i],
+                        chain_id=1,
+                    )
                 )
-            )
-            nonces[i] += 1
-        blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
+                nonces[i] += 1
+            blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
+        return blocks
 
-    # decode fresh objects: replay must pay sender recovery + RLP parse
-    # like a real sync would (the built objects carry cached senders)
-    from khipu_tpu.domain.block import Block as _Block
-
-    wire = [b.encode() for b in blocks]
-    blocks = [_Block.decode(w) for w in wire]
-
-    target = Blockchain(Storages(), cfg)
-    target.load_genesis(GenesisSpec(alloc=alloc))
-    driver = ReplayDriver(target, cfg, device_commit=True)
-    stats = driver.replay(blocks)
+    stats = _replay_fixture(
+        parallel, window, {a: 10**24 for a in addrs}, build,
+        device_commit=True,
+    )
     emit(
         metric,
         round(stats.blocks_per_s, 2),
@@ -147,34 +162,14 @@ def bench_replay_contended(n_blocks=8, txs_per_block=50, hot_recipients=4,
     touching a hot balance slot reads what an earlier tx wrote and must
     re-run serially (Ledger.scala:393-434 path). Token bytecode runs on
     the native EVM when built."""
-    import dataclasses
-
-    from khipu_tpu.base.crypto.secp256k1 import (
-        privkey_to_pubkey,
-        pubkey_to_address,
-    )
-    from khipu_tpu.config import SyncConfig, fixture_config
-    from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
     from khipu_tpu.domain.transaction import (
         Transaction,
         contract_address,
         sign_transaction,
     )
-    from khipu_tpu.storage.storages import Storages
-    from khipu_tpu.sync.chain_builder import ChainBuilder
-    from khipu_tpu.sync.replay import ReplayDriver
-    from khipu_tpu.domain.block import Block as _Block
 
-    cfg = fixture_config(chain_id=1)
-    cfg = dataclasses.replace(
-        cfg,
-        sync=SyncConfig(
-            parallel_tx=True, tx_workers=8, commit_window_blocks=window,
-        ),
-    )
     nsenders = txs_per_block  # one tx per sender per block: distinct nonces
-    keys = [(i + 101).to_bytes(32, "big") for i in range(nsenders)]
-    addrs = [pubkey_to_address(privkey_to_pubkey(k)) for k in keys]
+    keys, addrs = _replay_keys(nsenders, seed_base=101)
     alloc = {a: 10**24 for a in addrs}
 
     # token runtime: balance[CALLER] -= amt; balance[to] += amt
@@ -197,55 +192,51 @@ def bench_replay_contended(n_blocks=8, txs_per_block=50, hot_recipients=4,
         + bytes([0x60, len(runtime), 0x60, 32 - len(runtime), 0xF3])
     )
 
-    builder = ChainBuilder(
-        Blockchain(Storages(), cfg), cfg, GenesisSpec(alloc=alloc)
-    )
-    blocks = [
-        builder.add_block(
-            [sign_transaction(
-                Transaction(0, 10**9, 500_000, None, 0, payload=init),
-                keys[0], chain_id=1,
-            )],
-            coinbase=b"\xaa" * 20,
-        )
-    ]
     token = contract_address(addrs[0], 0)
     hot = [
         bytes.fromhex("%040x" % (0xA0000000 + i))
         for i in range(hot_recipients)
     ]
     cold = [bytes.fromhex("%040x" % (0xB0000000 + i)) for i in range(4096)]
-    nonces = [1] + [0] * (nsenders - 1)
     n_hot = max(1, int(txs_per_block * hot_fraction))
-    for n in range(n_blocks):
-        txs = []
-        for j in range(txs_per_block):
-            if j < n_hot:
-                to = hot[(j + n) % hot_recipients]
-            else:
-                to = cold[(n * txs_per_block + j * 13) % len(cold)]
-            payload = to.rjust(32, b"\x00") + (1).to_bytes(32, "big")
-            txs.append(
-                sign_transaction(
-                    Transaction(
-                        nonces[j], 10**9, 200_000, token, 0, payload=payload
-                    ),
-                    keys[j],
-                    chain_id=1,
-                )
-            )
-            nonces[j] += 1
-        blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
 
-    wire = [b.encode() for b in blocks]
-    blocks = [_Block.decode(w) for w in wire]
-    target = Blockchain(Storages(), cfg)
-    target.load_genesis(GenesisSpec(alloc=alloc))
+    def build(builder):
+        blocks = [
+            builder.add_block(
+                [sign_transaction(
+                    Transaction(0, 10**9, 500_000, None, 0, payload=init),
+                    keys[0], chain_id=1,
+                )],
+                coinbase=b"\xaa" * 20,
+            )
+        ]
+        nonces = [1] + [0] * (nsenders - 1)
+        for n in range(n_blocks):
+            txs = []
+            for j in range(txs_per_block):
+                if j < n_hot:
+                    to = hot[(j + n) % hot_recipients]
+                else:
+                    to = cold[(n * txs_per_block + j * 13) % len(cold)]
+                payload = to.rjust(32, b"\x00") + (1).to_bytes(32, "big")
+                txs.append(
+                    sign_transaction(
+                        Transaction(
+                            nonces[j], 10**9, 200_000, token, 0,
+                            payload=payload,
+                        ),
+                        keys[j],
+                        chain_id=1,
+                    )
+                )
+                nonces[j] += 1
+            blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
+        return blocks
+
     # host commit: this metric isolates parallel-execution + merge cost
     # under contention (the windowed device-commit cost is the previous
     # metric's job); device_commit here would drown it in tunnel latency
-    driver = ReplayDriver(target, cfg, device_commit=False)
-    stats = driver.replay(blocks)
+    stats = _replay_fixture(True, window, alloc, build, device_commit=False)
     from khipu_tpu.evm.native_vm import available as native_available
 
     emit(

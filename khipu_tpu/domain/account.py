@@ -8,6 +8,7 @@ own storage trie; codeHash keys the EVM bytecode in the evmcode store.
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
+from functools import lru_cache
 
 from khipu_tpu.base.crypto.keccak import keccak256
 from khipu_tpu.base.rlp import rlp_decode, rlp_encode
@@ -69,6 +70,9 @@ class Account:
         return self.code_hash != EMPTY_CODE_HASH
 
 
+@lru_cache(maxsize=1 << 16)
 def address_key(address: bytes) -> bytes:
-    """State-trie key for an address (Address.scala hashed-key encoder)."""
+    """State-trie key for an address (Address.scala hashed-key encoder).
+    Memoized: replay hits the same hot addresses (senders, coinbase,
+    contracts) thousands of times per epoch."""
     return keccak256(address)

@@ -262,6 +262,7 @@ def execute_block(
     khipu_config: KhipuConfig,
     validate: bool = True,
     check_root: bool = True,
+    hasher=None,
 ) -> BlockResult:
     """Execute every tx of a block and gate the result against the
     header (executeBlock:230 + validateBlockAfterExecution:603-620).
@@ -357,7 +358,7 @@ def execute_block(
     stats.exec_seconds = time.perf_counter() - t0
 
     if validate:
-        _validate_after(block, world, receipts, gas_used, check_root)
+        _validate_after(block, world, receipts, gas_used, check_root, hasher)
     return BlockResult(world, receipts, gas_used, stats)
 
 
@@ -497,7 +498,7 @@ def _pay_rewards(world: BlockWorldState, block: Block, khipu_config) -> None:
 
 def _validate_after(
     block: Block, world: BlockWorldState, receipts: List[Receipt],
-    gas_used: int, check_root: bool = True,
+    gas_used: int, check_root: bool = True, hasher=None,
 ) -> None:
     """The bit-exactness gate (Ledger.scala:603-620). ``check_root``
     False defers the state-root comparison to the caller (window mode
@@ -511,7 +512,14 @@ def _validate_after(
             f"{header.gas_used}"
         )
     if check_root:
-        root = world.root_hash
+        # flush IN PLACE (not on a copy): the block's execution is
+        # complete, and world.flush() is accumulate-safe so the caller's
+        # subsequent persist() reuses this work instead of repeating the
+        # whole materialize+insert pass (the former root_hash-on-a-copy
+        # doubled the per-block trie cost). ``hasher`` must be the same
+        # one the caller will persist with — otherwise the device-commit
+        # path would be silently bypassed here.
+        root = world.flush(hasher).account_trie.root_hash
         if root != header.state_root:
             raise ValidationAfterExecError(
                 f"block {header.number}: stateRoot {root.hex()} != header "

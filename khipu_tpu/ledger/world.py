@@ -148,6 +148,10 @@ class BlockWorldState:
         self.account_start_nonce = account_start_nonce
 
         self.accounts: Dict[bytes, Optional[Account]] = {}
+        # parent-trie account memo: the parent root is immutable for the
+        # world's lifetime and Account is frozen, so lookups memoize;
+        # SHARED by reference across copy() (rebound after flush()).
+        self._tacct: Dict[bytes, Optional[Account]] = {}
         self.deltas: Dict[bytes, AccountDelta] = {}
         self.storages: Dict[bytes, TrieStorage] = {}
         self.codes: Dict[bytes, bytes] = {}  # address -> code written
@@ -185,6 +189,7 @@ class BlockWorldState:
         w.get_block_hash = self.get_block_hash
         w.account_start_nonce = self.account_start_nonce
         w.accounts = dict(self.accounts)
+        w._tacct = self._tacct
         w.deltas = {a: AccountDelta(d.nonce, d.balance) for a, d in self.deltas.items()}
         w.storages = {a: s.copy() for a, s in self.storages.items()}
         w.codes = dict(self.codes)
@@ -197,8 +202,13 @@ class BlockWorldState:
     # ------------------------------------------------------------- reads
 
     def _trie_account(self, address: bytes) -> Optional[Account]:
+        cache = self._tacct
+        if address in cache:
+            return cache[address]
         raw = self.account_trie.get(address_key(address))
-        return Account.decode(raw) if raw is not None else None
+        acc = Account.decode(raw) if raw is not None else None
+        cache[address] = acc
+        return acc
 
     def _current_account(self, address: bytes) -> Optional[Account]:
         """Materialized view: log entry (or parent trie) + pending
@@ -514,8 +524,13 @@ class BlockWorldState:
         account trie) runs through the level-synchronous deferred path
         (trie.deferred.batch_commit) — one batched Keccak call per node
         level, the TPU-commit integration of SURVEY §2.8(c). hasher=None
-        keeps the eager host MPT (the bit-exactness oracle)."""
-        self._flushed_storage_tries: Dict[bytes, MerklePatriciaTrie] = {}
+        keeps the eager host MPT (the bit-exactness oracle).
+
+        flush() is idempotent-safe: a second call (persist() after an
+        in-place root validation) ACCUMULATES into the pending storage
+        tries / codes instead of discarding the first flush's output."""
+        if not hasattr(self, "_flushed_storage_tries"):
+            self._flushed_storage_tries: Dict[bytes, MerklePatriciaTrie] = {}
         final = self._materialized_accounts(hasher)
         upserts, removes = [], []
         for addr in sorted(final):
@@ -538,13 +553,16 @@ class BlockWorldState:
             for key, enc in upserts:
                 trie = trie.put(key, enc)
             self.account_trie = trie
-        self._pending_codes = {
-            keccak256(code): code for code in self.codes.values() if code
-        }
+        pending = getattr(self, "_pending_codes", {})
+        pending.update(
+            (keccak256(code), code) for code in self.codes.values() if code
+        )
+        self._pending_codes = pending
         self.accounts.clear()
         self.deltas.clear()
         self.storages.clear()
         self.codes.clear()
+        self._tacct = {}  # the parent root advanced: old memo is stale
         return self
 
     @property

@@ -53,9 +53,15 @@ class NodeStorage:
         # values; dropping the ring without evicting those keys would
         # keep serving nodes that were never durably written (and mask
         # MPTNodeMissingException after a reorg + restart). Evict only
-        # the dropped keys — confirmed hot nodes stay cached.
+        # the dropped keys — confirmed hot nodes stay cached. The trie
+        # layer's decoded-node cache (mpt.py attaches _mpt_dcache to its
+        # source, i.e. this object) reads through get() and can hold the
+        # same unconfirmed nodes — evict there too.
+        dcache = getattr(self, "_mpt_dcache", None)
         for key in self._unconfirmed.clear_unconfirmed():
             self._cache.remove(key)
+            if dcache is not None:
+                dcache.pop(key, None)
 
     def flush(self) -> None:
         self._unconfirmed.flush()

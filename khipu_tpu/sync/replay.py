@@ -212,6 +212,7 @@ class ReplayDriver:
             self.blockchain.get_world_state,
             self.config,
             validate=True,
+            hasher=self.hasher,  # root check + persist share one flush
         )
         td = (
             self.blockchain.get_total_difficulty(parent.number) or 0

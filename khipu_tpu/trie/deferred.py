@@ -74,6 +74,13 @@ class DeferredMPT(MerklePatriciaTrie):
         # the right store
         self._counter = counter if counter is not None else [0]
         self._ref_sink = ref_sink
+        # SESSION-local decode cache, never the source-attached one:
+        # placeholder refs are NOT content-addressed (the per-process
+        # prefix + a restarting counter reuses the same byte strings
+        # across sessions with different content), so a cross-session
+        # cache would serve stale structures. Within one session each
+        # placeholder is staged write-once, so caching is sound.
+        self._dcache = {}
 
     def _child(self) -> "DeferredMPT":
         t = DeferredMPT(self.source)
@@ -82,6 +89,7 @@ class DeferredMPT(MerklePatriciaTrie):
         t._staged = self._staged
         t._counter = self._counter
         t._ref_sink = self._ref_sink
+        t._dcache = self._dcache
         return t
 
     def _ref(self, node):

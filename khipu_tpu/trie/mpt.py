@@ -79,7 +79,7 @@ class MerklePatriciaTrie:
     ``update(to_remove, to_upsert)`` when present, else ``put``.
     """
 
-    __slots__ = ("source", "_root_ref", "_logs", "_staged")
+    __slots__ = ("source", "_root_ref", "_logs", "_staged", "_dcache")
 
     def __init__(
         self,
@@ -90,6 +90,20 @@ class MerklePatriciaTrie:
         _staged: Optional[Dict[bytes, bytes]] = None,
     ):
         self.source = source
+        # Decoded-node cache, attached to the SOURCE so it survives
+        # across trie instances/blocks: nodes are content-addressed
+        # (hash -> immutable bytes) and resolved structures are never
+        # mutated in place (_insert/_delete copy before writing), so a
+        # shared decode cache is sound. Falls back to per-trie when the
+        # source can't carry attributes.
+        try:
+            self._dcache = source._mpt_dcache
+        except AttributeError:
+            try:
+                source._mpt_dcache = {}
+                self._dcache = source._mpt_dcache
+            except AttributeError:
+                self._dcache = {}
         if _root_ref is not None:
             self._root_ref = _root_ref
         elif root_hash is None or root_hash == EMPTY_TRIE_HASH:
@@ -144,16 +158,28 @@ class MerklePatriciaTrie:
             return ref
         if ref == BLANK:
             return BLANK
+        cache = self._dcache
+        node = cache.get(ref)
+        if node is not None:
+            return node
         encoded = self._staged.get(ref)
         if encoded is None:
             log = self._logs.get(ref)
             if log is not None and log[0] > 0:
                 encoded = log[1]
-        if encoded is None:
-            encoded = self.source.get(ref)
+        if encoded is not None:
+            # session-local (staged/log) nodes are NOT cached: they may
+            # never be durably written, and a shared cache would keep
+            # serving them after the session is dropped
+            return rlp_decode(encoded)
+        encoded = self.source.get(ref)
         if encoded is None:
             raise MPTNodeMissingException(ref)
-        return rlp_decode(encoded)
+        node = rlp_decode(encoded)
+        if len(cache) >= 262144:  # bound memory; hot top levels re-warm
+            cache.clear()
+        cache[ref] = node
+        return node
 
     # ---------------------------------------------------------- updates
 

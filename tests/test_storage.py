@@ -112,6 +112,31 @@ def test_node_storage_reorg_drops_cached_unconfirmed():
     assert ns.get(h) is None
 
 
+def test_node_storage_reorg_evicts_trie_decode_cache():
+    """The MPT layer attaches a decoded-node cache to its source; a
+    reorg must evict dropped unconfirmed nodes from it too, or tries
+    would keep resolving orphaned hashes instead of raising
+    MPTNodeMissingException (which drives the heal/fetch path)."""
+    import pytest
+
+    from khipu_tpu.trie.mpt import MerklePatriciaTrie, MPTNodeMissingException
+
+    src = MemoryNodeDataSource()
+    ns = NodeStorage(src, depth=4, cache_size=1024)
+    trie = MerklePatriciaTrie(ns)
+    for i in range(40):  # enough to hash the root (>=32B nodes)
+        trie = trie.put(keccak256(bytes([i])), b"v" * 40)
+    ns.switch_to_unconfirmed()
+    trie.persist()  # nodes land in the unconfirmed ring only
+    root = trie.root_hash
+    # resolve through a FRESH trie so the decode cache holds ring nodes
+    reopened = MerklePatriciaTrie(ns, root_hash=root)
+    assert reopened.get(keccak256(bytes([0]))) == b"v" * 40
+    ns.clear_unconfirmed()  # reorg: ring dropped before any flush
+    with pytest.raises(MPTNodeMissingException):
+        MerklePatriciaTrie(ns, root_hash=root).get(keccak256(bytes([0])))
+
+
 def test_block_numbers_header_storage_fallback():
     """hash_of falls back to the persisted header after a 'restart'
     (fresh BlockNumbers over the same storages) — BlockNumbers.scala

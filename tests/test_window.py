@@ -84,6 +84,30 @@ def window_cfg(w, parallel=True):
 
 
 class TestWindowedReplay:
+    def test_window1_device_path_uses_hasher(self, chain):
+        """window=1 replay with a device hasher: the in-place root
+        validation inside execute_block must flush with THAT hasher —
+        not silently fall back to the eager host path (regression: the
+        validate-then-persist fusion bypassed the batched commit)."""
+        from khipu_tpu.trie.bulk import host_hasher
+
+        calls = [0]
+
+        def counting_hasher(msgs):
+            calls[0] += 1
+            return host_hasher(msgs)
+
+        blocks, caddr = chain
+        cfg = window_cfg(1)
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
+        driver = ReplayDriver(bc, cfg, device_commit=True)
+        driver.hasher = counting_hasher
+        stats = driver.replay(blocks)
+        assert stats.blocks == 5
+        assert calls[0] > 0, "batched hasher never ran on the w=1 path"
+        assert bc.get_header_by_number(5).hash == blocks[-1].hash
+
     @pytest.mark.parametrize("window", [2, 3, 5, 8])
     def test_windowed_equals_per_block(self, chain, window):
         """Any window size produces the identical chain state as the
