@@ -61,9 +61,11 @@ class WindowCommitter:
     def __init__(self, storages, parent_root: bytes,
                  hasher: Hasher = host_hasher,
                  account_start_nonce: int = 0,
-                 get_block_hash=None):
+                 get_block_hash=None,
+                 fused: bool = False):
         self.storages = storages
         self.hasher = hasher
+        self.fused = fused  # one-dispatch finalize (trie/fused.py)
         self.account_start_nonce = account_start_nonce
         self.get_block_hash = get_block_hash or (lambda n: None)
 
@@ -153,7 +155,8 @@ class WindowCommitter:
         synchronous), CHECK every block root against its header, persist
         all nodes + codes. Returns [(header, real_root)]."""
         resolved_trie, mapping = finalize_deferred(
-            self.account_trie, self.hasher, return_mapping=True
+            self.account_trie, self.hasher, return_mapping=True,
+            fused=self.fused,
         )
 
         results: List[Tuple[BlockHeader, bytes]] = []

@@ -122,6 +122,10 @@ class ReplayDriver:
                     self.config.blockchain.account_start_nonce
                 ),
                 get_block_hash=block_hash_of,
+                # device mode: one-dispatch fixpoint finalize — the
+                # per-level hasher loop would pay O(levels) tunnel
+                # round-trips per window (docs/roofline.md)
+                fused=self.hasher is not None,
             )
             results = []
             prev = parent

@@ -185,6 +185,7 @@ def finalize(
     trie: DeferredMPT,
     hasher: Hasher = host_hasher,
     return_mapping: bool = False,
+    fused: bool = False,
 ):
     """Hash the live placeholder DAG bottom-up, one batch per level.
 
@@ -192,6 +193,11 @@ def finalize(
     net refcount 0) were already dropped by the MPT's refcount log.
     With ``return_mapping``, returns (trie, {placeholder: real_hash})
     — the window committer resolves per-block root refs through it.
+
+    With ``fused``, the whole DAG resolves in ONE device dispatch
+    (trie/fused.py fixpoint program) instead of one hasher call per
+    level — the dispatch-latency fix for windowed device commit; falls
+    back to the level loop when the window shape is unsupported.
     """
     # live placeholders: positive log entries with placeholder keys
     live: Dict[bytes, bytes] = {}  # placeholder -> encoded (raw)
@@ -234,7 +240,29 @@ def finalize(
 
     resolved: Dict[bytes, bytes] = {}  # placeholder -> real hash
     final_encoded: Dict[bytes, bytes] = {}  # real hash -> final rlp
-    pending = dict(deps)
+    if fused and to_resolve:
+        try:
+            import jax
+
+            from khipu_tpu.trie.fused import (
+                FusedUnsupported,
+                fused_resolve,
+            )
+
+            jnp_path = jax.default_backend() != "tpu"
+            resolved = fused_resolve(
+                to_resolve, deps, _PLACEHOLDER_PREFIX, use_jnp=jnp_path
+            )
+            # substitution is length-invariant, so the byte-level
+            # substitute over the RAW encoding equals the loop path's
+            # decode -> substitute -> re-encode
+            for ph, enc in to_resolve.items():
+                final_encoded[resolved[ph]] = _substitute_bytes(
+                    enc, resolved
+                )
+        except FusedUnsupported:
+            resolved = {}
+    pending = {} if resolved else dict(deps)
     while pending:
         level = [
             ph

@@ -1,0 +1,262 @@
+"""Fused window finalize: the whole placeholder DAG in ONE device dispatch.
+
+The level-synchronous finalize (deferred.finalize) issues one batched
+hash call per trie level — O(levels) dispatches per window. Through the
+axon tunnel each materialized dispatch costs ~91 ms (docs/roofline.md),
+which dwarfs the kernel time and makes windowed device commit ~20x
+slower than the host path in this environment.
+
+This module replaces the level loop with a FIXPOINT iteration compiled
+into a single XLA program:
+
+  1. Host packs every staged node's raw encoding (multi-rate padded)
+     into per-rate-class u8 buffers and scans each encoding once for
+     its placeholder spans -> static substitution triples
+     (parent_row, byte_offset, child_index).
+  2. One jitted program runs `depth` rounds of
+         digests = keccak(all nodes)        # pallas, per class
+         encodings[parent, off:off+32] = digests[child]
+     After k rounds every node within k levels of the leaves carries
+     its final digest — after `depth` rounds all do.
+
+Substitution is length-invariant (a placeholder is exactly 32 bytes,
+replaced by a 32-byte hash; RLP headers never change — the same
+invariant the host substitution relies on), so byte offsets recorded
+from the RAW encodings stay valid through every round.
+
+The extra compute (depth x N hashes instead of N) is noise next to the
+dispatch latency it removes: a W=40 window carries a few thousand nodes
+and the kernel runs tens of millions of hashes/s/chip.
+
+Shapes are bucketed ({1,2,4,8,16} tiles per class, pow-2 substitution
+counts, pow-2 depth) so a handful of compiled variants serves every
+window.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from khipu_tpu.ops.keccak_jnp import RATE
+
+MAX_DEPTH = 64  # DAG deeper than this falls back to the level loop
+
+
+class FusedUnsupported(Exception):
+    """Raised when the fused path cannot handle this window (the caller
+    falls back to the per-level hasher loop)."""
+
+
+def dag_depth(deps: Dict[bytes, List[bytes]]) -> int:
+    """Height of the dependency DAG (leaves = 1). Raises AssertionError
+    on a cycle / unresolvable reference — same contract as the level
+    loop in deferred.finalize."""
+    depth: Dict[bytes, int] = {}
+    pending = dict(deps)
+    d = 0
+    while pending:
+        level = [
+            ph for ph, cs in pending.items()
+            if all(c in depth for c in cs)
+        ]
+        if not level:
+            raise AssertionError("placeholder dependency cycle")
+        d += 1
+        for ph in level:
+            depth[ph] = d
+            del pending[ph]
+    return d
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    v = floor
+    while v < n:
+        v *= 2
+    return v
+
+
+@functools.lru_cache(maxsize=64)
+def _build_fused(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
+                 use_jnp: bool):
+    """Compile the fixpoint program for a shape signature.
+
+    sig: per class (nblocks, nrows, nsubs), nrows % TILE == 0.
+    Inputs: for each class, enc u8[nrows, nblocks*RATE]; then for each
+    class rows32 i32[nsubs*32], cols32 i32[nsubs*32], child i32[nsubs].
+    Output: per-class digest u8[nrows, 32].
+
+    ``use_jnp``: hash via the jnp sponge (XLA-compiled, the CPU/test
+    path) instead of the Pallas kernel (TPU) — pallas interpret mode is
+    orders of magnitude too slow for a fixpoint loop.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if use_jnp:
+        from khipu_tpu.ops.keccak_jnp import absorb
+
+        def _mk_runner(nb):
+            nwords = nb * 34
+
+            def go(padded_u8):  # u8[N, nb*RATE] -> u8[N, 32]
+                n = padded_u8.shape[0]
+                w = jax.lax.bitcast_convert_type(
+                    padded_u8.reshape(n, nwords, 4), jnp.uint32
+                )
+                blocks = w.reshape(n, nb, 34).transpose(1, 2, 0)
+                d = absorb(blocks, nb)  # [8, N]
+                return jax.lax.bitcast_convert_type(
+                    d.T, jnp.uint8
+                ).reshape(n, 32)
+
+            return go
+
+        runners = [_mk_runner(nb) for nb, _, _ in sig]
+    else:
+        from khipu_tpu.ops.keccak_pallas import _build_from_bytes
+
+        runners = [_build_from_bytes(nb, False) for nb, _, _ in sig]
+    k = len(sig)
+
+    @jax.jit
+    def run(*args):
+        encs = list(args[:k])
+        subs = args[k:]
+
+        def hash_all(encs):
+            return [runners[c](encs[c]) for c in range(k)]
+
+        def body(_, carry):
+            encs, _ = carry
+            digs = hash_all(encs)
+            G = jnp.concatenate(digs, axis=0)  # [sum rows, 32] u8
+            new_encs = []
+            for c in range(k):
+                rows32 = subs[3 * c]
+                cols32 = subs[3 * c + 1]
+                child = subs[3 * c + 2]
+                vals = G[child].reshape(-1)  # [nsubs*32] u8
+                new_encs.append(encs[c].at[rows32, cols32].set(vals))
+            return new_encs, digs
+
+        encs, digs = jax.lax.fori_loop(
+            0, rounds, body, (encs, hash_all(encs))
+        )
+        return digs
+
+    return run
+
+
+def fused_resolve(
+    to_resolve: Dict[bytes, bytes],
+    deps: Dict[bytes, List[bytes]],
+    prefix: bytes,
+    use_jnp: bool = False,
+) -> Dict[bytes, bytes]:
+    """Resolve placeholder -> real Keccak-256 hash for every entry of
+    ``to_resolve`` (placeholder -> raw encoding) in one device dispatch.
+
+    ``deps`` is the child map from deferred.finalize (already restricted
+    to session-known placeholders); ``prefix`` is the session's
+    placeholder prefix for the offset scan.
+    """
+    if not to_resolve:
+        return {}
+    depth = dag_depth(deps)
+    if depth > MAX_DEPTH:
+        raise FusedUnsupported(f"DAG depth {depth} > {MAX_DEPTH}")
+
+    from khipu_tpu.ops.keccak_pallas import _pallas_target_count
+
+    phs = list(to_resolve)
+
+    # bucket rows by rate-block class; the class set is pinned to a
+    # CANONICAL {1..4} (a state-trie node never exceeds 4 rate blocks:
+    # max branch ~532 B) so every window shares one compiled signature —
+    # windows whose organic class sets differ would otherwise each pay a
+    # fresh multi-second XLA compile. Larger classes appear only for
+    # exotic long-value tries and extend the signature organically.
+    classes: Dict[int, List[bytes]] = {c: [] for c in (1, 2, 3, 4)}
+    for ph in phs:
+        nb = len(to_resolve[ph]) // RATE + 1
+        classes.setdefault(nb, []).append(ph)
+    class_list = sorted(classes)
+
+    # global digest index = class-major position (class order, row order)
+    dpos: Dict[bytes, int] = {}
+    base = 0
+    nrows_pad: Dict[int, int] = {}
+    for nb in class_list:
+        rows = classes[nb]
+        # +1 guarantees at least one spare padding row for dummy subs;
+        # pallas needs whole 1024-row tiles, the jnp path only pow-2
+        if use_jnp:
+            nrows_pad[nb] = _pow2(len(rows) + 1, floor=16)
+        else:
+            nrows_pad[nb] = _pallas_target_count(nb, len(rows) + 1)
+        for r, ph in enumerate(rows):
+            dpos[ph] = base + r
+        base += nrows_pad[nb]
+
+    enc_bufs: List[np.ndarray] = []
+    sub_arrays: List[np.ndarray] = []
+    sig: List[Tuple[int, int, int]] = []
+    for nb in class_list:
+        rows = classes[nb]
+        width = nb * RATE
+        buf = np.zeros((nrows_pad[nb], width), dtype=np.uint8)
+        subs: List[Tuple[int, int, int]] = []  # (row, off, child_gpos)
+        for r, ph in enumerate(rows):
+            enc = to_resolve[ph]
+            buf[r, : len(enc)] = np.frombuffer(enc, dtype=np.uint8)
+            buf[r, len(enc)] ^= 0x01  # multi-rate pad (fixed region:
+            buf[r, width - 1] ^= 0x80  # substitution never touches it)
+            pos = enc.find(prefix)
+            while pos >= 0:
+                child = enc[pos : pos + 32]
+                cp = dpos.get(child)
+                if cp is not None:
+                    subs.append((r, pos, cp))
+                pos = enc.find(prefix, pos + 32)
+        # padding rows still need valid keccak padding (their digests
+        # are discarded, but the kernel hashes them)
+        for r in range(len(rows), nrows_pad[nb]):
+            buf[r, 0] ^= 0x01
+            buf[r, width - 1] ^= 0x80
+        # coarse floor: windows of similar size must land in the SAME
+        # compiled signature (every distinct shape costs a fresh XLA
+        # compile on the first window that hits it)
+        nsubs = _pow2(len(subs) + 1, floor=1024 if use_jnp else 4096)
+        dummy_row = nrows_pad[nb] - 1  # guaranteed padding row
+        while len(subs) < nsubs:
+            subs.append((dummy_row, 0, 0))
+        rows32 = np.empty(nsubs * 32, dtype=np.int32)
+        cols32 = np.empty(nsubs * 32, dtype=np.int32)
+        child = np.empty(nsubs, dtype=np.int32)
+        for m, (r, off, cp) in enumerate(subs):
+            rows32[m * 32 : (m + 1) * 32] = r
+            cols32[m * 32 : (m + 1) * 32] = np.arange(
+                off, off + 32, dtype=np.int32
+            )
+            child[m] = cp
+        enc_bufs.append(buf)
+        sub_arrays.extend([rows32, cols32, child])
+        sig.append((nb, nrows_pad[nb], nsubs))
+
+    rounds = _pow2(depth, floor=8)  # coarse: depth 5 and 8 share a compile
+    run = _build_fused(tuple(sig), rounds, use_jnp)
+    import jax
+
+    digs = run(*[*enc_bufs, *sub_arrays])
+    digs = [np.asarray(jax.device_get(d)) for d in digs]
+
+    out: Dict[bytes, bytes] = {}
+    for ci, nb in enumerate(class_list):
+        rows = classes[nb]
+        d = digs[ci]
+        for r, ph in enumerate(rows):
+            out[ph] = d[r].tobytes()
+    return out
