@@ -94,6 +94,10 @@ def _replay_fixture(parallel, window, alloc, build_blocks, device_commit):
     )
     blocks = [_Block.decode(b.encode()) for b in build_blocks(builder)]
     if device_commit:
+        # NB: keep n_blocks a MULTIPLE of window — a trailing partial
+        # window would land in a different compiled shape bucket than
+        # the one this warm-up compiles, re-introducing cold-compile
+        # skew into the timed region
         warm = Blockchain(Storages(), cfg)
         warm.load_genesis(GenesisSpec(alloc=alloc))
         # fresh decodes: the warm-up must not pre-populate the cached

@@ -104,6 +104,12 @@ class SyncConfig:
     block_resolving_depth: int = 20
     parallel_tx: bool = True  # optimistic parallel execution (P1)
     tx_workers: int = 8  # worker pool width (TxProcessor.scala:29 role)
+    # fast-sync pivot choice (FastSyncService.scala:184-273 role)
+    min_peers_to_choose_pivot: int = 5
+    pivot_block_offset: int = 500  # pivot = median(best) - offset
+    # node-download scheduler (processDownload:537-667 role)
+    nodes_per_request: int = 50
+    peer_request_timeout: float = 5.0
     commit_window_blocks: int = 1  # blocks batched per TPU trie commit
     # opcode-level trace for ONE block number (debug-trace-at;
     # VM.scala:40-57) — that block runs sequentially with a per-op line

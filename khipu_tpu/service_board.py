@@ -39,6 +39,8 @@ class ServiceBoard:
         self._bridge_server = None
         self._peer_manager = None
         self._discovery = None
+        self._regular_sync = None
+        self._fast_sync = None
 
     # ---------------------------------------------------------- node key
 
@@ -128,6 +130,30 @@ class ServiceBoard:
         )
         HostService(self.blockchain).install(self._peer_manager)
         return self._peer_manager.listen(host, port)
+
+    def start_regular_sync(self, **kwargs):
+        """Tip-following block import over the peer pool
+        (RegularSyncService.scala role); requires start_network."""
+        from khipu_tpu.sync.regular_sync import RegularSyncService
+
+        if self._peer_manager is None:
+            raise RuntimeError("start_network first")
+        self._regular_sync = RegularSyncService(
+            self.blockchain, self.config, self._peer_manager, **kwargs
+        )
+        return self._regular_sync
+
+    def start_fast_sync(self, **kwargs):
+        """Pivot choice + multi-peer state download
+        (FastSyncService.scala role); requires start_network."""
+        from khipu_tpu.sync.fast_sync_service import FastSyncService
+
+        if self._peer_manager is None:
+            raise RuntimeError("start_network first")
+        self._fast_sync = FastSyncService(
+            self.blockchain, self.config, self._peer_manager, **kwargs
+        )
+        return self._fast_sync
 
     def start_discovery(self, host: str = "127.0.0.1", port: int = 30303) -> int:
         from khipu_tpu.network.discovery import DiscoveryService

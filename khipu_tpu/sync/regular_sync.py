@@ -1,0 +1,293 @@
+"""Live regular sync: tip-following block import over real peers.
+
+Parity: blockchain/sync/RegularSyncService.scala —
+  bestPeer selection by total difficulty        :448-479
+  requestHeaders / requestBodies batch fetch    :103-170
+  branch resolution with backward header fetch,
+  TD-compared reorg                             :171-269, 336-345
+  missing-node retry inside the import loop     (kesque self-heal role)
+
+The Akka actor round (one message per state transition) becomes an
+explicit ``sync_once()`` step — callers loop it (``run(until)``), tests
+drive it deterministically. Execution and persistence reuse the replay
+driver's validated import path (ReplayDriver._execute_and_insert), so a
+live-synced block passes exactly the gates a replayed one does.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.domain.block import Block, BlockBody
+from khipu_tpu.domain.block_header import BlockHeader
+from khipu_tpu.domain.blockchain import Blockchain
+from khipu_tpu.config import KhipuConfig
+from khipu_tpu.network.messages import (
+    BLOCK_BODIES,
+    BLOCK_HEADERS,
+    ETH_OFFSET,
+    GET_BLOCK_BODIES,
+    GET_BLOCK_HEADERS,
+    GET_NODE_DATA,
+    NODE_DATA,
+    GetBlockHeaders,
+    decode_bodies,
+    decode_headers,
+)
+from khipu_tpu.network.peer import Peer, PeerError, PeerManager
+from khipu_tpu.sync.replay import ReplayDriver
+from khipu_tpu.trie.mpt import MPTNodeMissingException
+from khipu_tpu.validators.roots import ommers_hash, transactions_root
+
+
+class SyncAborted(Exception):
+    pass
+
+
+class RegularSyncService:
+    """Pull loop: find the best-TD peer, fetch headers+bodies from our
+    tip, import; resolve side branches by backward fetch + TD compare."""
+
+    def __init__(
+        self,
+        blockchain: Blockchain,
+        config: KhipuConfig,
+        manager: PeerManager,
+        batch_size: int = 10,
+        request_timeout: float = 5.0,
+        log: Optional[Callable[[str], None]] = None,
+        device_commit: bool = False,
+    ):
+        self.blockchain = blockchain
+        self.config = config
+        self.manager = manager
+        self.batch_size = batch_size
+        self.timeout = request_timeout
+        self.log = log or (lambda s: None)
+        self._driver = ReplayDriver(
+            blockchain, config, device_commit=device_commit
+        )
+        self.imported = 0
+        self.reorgs = 0
+        self.healed_nodes = 0
+
+    # ------------------------------------------------------------ fetches
+
+    def _request_headers(
+        self, peer: Peer, start, max_headers: int, reverse: bool = False
+    ) -> List[BlockHeader]:
+        body = peer.request(
+            ETH_OFFSET + GET_BLOCK_HEADERS,
+            GetBlockHeaders(start, max_headers, 0, reverse).body(),
+            ETH_OFFSET + BLOCK_HEADERS,
+            timeout=self.timeout,
+        )
+        return decode_headers(body)
+
+    def _request_bodies(
+        self, peer: Peer, hashes: List[bytes]
+    ) -> List[BlockBody]:
+        body = peer.request(
+            ETH_OFFSET + GET_BLOCK_BODIES,
+            list(hashes),
+            ETH_OFFSET + BLOCK_BODIES,
+            timeout=self.timeout,
+        )
+        return decode_bodies(body)
+
+    def _fetch_blocks(
+        self, peer: Peer, headers: List[BlockHeader]
+    ) -> List[Block]:
+        """Bodies for ``headers``; every body is checked against its
+        header's txRoot/ommersHash before assembly (a peer cannot hand
+        us a mismatched body)."""
+        blocks: List[Block] = []
+        want = list(headers)
+        while want:
+            batch = want[: self.batch_size]
+            bodies = self._request_bodies(peer, [h.hash for h in batch])
+            if not bodies:
+                raise PeerError("peer returned no bodies")
+            for header, body in zip(batch, bodies):
+                if transactions_root(body.transactions) != header.transactions_root:
+                    raise PeerError("body txRoot mismatch")
+                if ommers_hash(body.ommers) != header.ommers_hash:
+                    raise PeerError("body ommersHash mismatch")
+                blocks.append(Block(header, body))
+            want = want[len(bodies) :]
+        return blocks
+
+    # ------------------------------------------------------- branch logic
+
+    def _resolve_branch(
+        self, peer: Peer, headers: List[BlockHeader]
+    ) -> Optional[List[BlockHeader]]:
+        """Headers don't attach to our chain tip: walk the peer's chain
+        backward (block_resolving_depth cap) until a header's parent is
+        known to us, then decide the reorg by total difficulty
+        (RegularSyncService.scala:171-269)."""
+        chain = list(headers)
+        depth_left = self.config.sync.block_resolving_depth
+        while depth_left > 0:
+            ancestor = self.blockchain.get_header_by_hash(
+                chain[0].parent_hash
+            )
+            if ancestor is not None:
+                return self._maybe_reorg(chain, ancestor)
+            fetch = min(self.batch_size, depth_left)
+            older = self._request_headers(
+                peer, chain[0].parent_hash, fetch, reverse=True
+            )
+            if not older:
+                return None
+            # reverse fetch returns newest-first starting AT parent_hash
+            older = list(reversed(older))
+            if older[-1].hash != chain[0].parent_hash:
+                return None  # peer served garbage
+            chain = older + chain
+            depth_left -= len(older)
+        return None
+
+    def _maybe_reorg(
+        self, branch: List[BlockHeader], ancestor: BlockHeader
+    ) -> Optional[List[BlockHeader]]:
+        """Adopt the branch iff its cumulative TD beats ours
+        (appendNewBlock TD rule, RegularSyncService.scala:336-345).
+        Rolls our chain back to the ancestor on adoption."""
+        ancestor_td = self.blockchain.get_total_difficulty(ancestor.number)
+        if ancestor_td is None:
+            return None
+        branch_td = ancestor_td + sum(h.difficulty for h in branch)
+        our_best = self.blockchain.best_block_number
+        our_td = self.blockchain.get_total_difficulty(our_best) or 0
+        if branch_td <= our_td:
+            self.log(
+                f"side branch at #{ancestor.number} loses TD "
+                f"({branch_td} <= {our_td}); keeping our chain"
+            )
+            return None
+        # roll back to the common ancestor
+        n = our_best
+        while n > ancestor.number:
+            header = self.blockchain.get_header_by_number(n)
+            if header is None:
+                break
+            self.blockchain.remove_block(header.hash)
+            n -= 1
+        self.blockchain.storages.app_state.best_block_number = ancestor.number
+        self.reorgs += 1
+        self.log(
+            f"reorg to peer branch at #{ancestor.number} "
+            f"(td {branch_td} > {our_td}, {len(branch)} blocks)"
+        )
+        return branch
+
+    # ----------------------------------------------------------- healing
+
+    def _heal_missing_node(self, peer: Peer, node_hash: bytes) -> None:
+        """Fetch one trie node by hash and admit it (content-address
+        verified) into the node stores — the read-through self-heal the
+        kesque DistributedNodeStorage role performs (storage/remote.py),
+        wired into the live import loop."""
+        body = peer.request(
+            ETH_OFFSET + GET_NODE_DATA,
+            [node_hash],
+            ETH_OFFSET + NODE_DATA,
+            timeout=self.timeout,
+        )
+        for blob in body:
+            if keccak256(blob) == node_hash:
+                s = self.blockchain.storages
+                s.account_node_storage.put(node_hash, blob)
+                s.storage_node_storage.put(node_hash, blob)
+                self.healed_nodes += 1
+                return
+        raise PeerError(f"peer could not heal node {node_hash.hex()[:16]}")
+
+    # -------------------------------------------------------------- steps
+
+    def sync_once(self) -> int:
+        """One pull round; returns the number of blocks imported."""
+        peer = self.manager.best_peer()
+        if peer is None or peer.status is None:
+            return 0
+        our_best = self.blockchain.best_block_number
+        our_td = self.blockchain.get_total_difficulty(our_best) or 0
+        # NOTE: no early TD gate — peer.status carries the HANDSHAKE-time
+        # TD, stale the moment the peer advances. The reference keeps
+        # asking its best peer on every resume tick and lets the header
+        # response decide (RegularSyncService.ResumeRegularSyncTask);
+        # TD only picks the peer and judges branches.
+        try:
+            headers = self._request_headers(
+                peer, our_best + 1, self.batch_size
+            )
+        except PeerError:
+            self.manager.blacklist.add(peer.remote_pub, duration=60.0)
+            peer.disconnect()
+            return 0
+        if not headers:
+            if peer.status.total_difficulty <= our_td:
+                return 0  # nothing new and no TD claim: at the tip
+            # the peer claims higher TD but serves nothing at our tip+1:
+            # its chain forked below our best — probe backward from its
+            # best hash like the branch resolver would
+            headers = self._request_headers(
+                peer, peer.status.best_hash, self.batch_size, reverse=True
+            )
+            if not headers:
+                return 0
+            headers = list(reversed(headers))
+
+        tip = self.blockchain.get_hash_by_number(our_best)
+        if headers[0].parent_hash != tip:
+            resolved = self._resolve_branch(peer, headers)
+            if resolved is None:
+                return 0
+            headers = resolved
+
+        blocks = self._fetch_blocks(peer, headers)
+        imported = 0
+        for block in blocks:
+            for attempt in range(3):
+                try:
+                    self._driver._execute_and_insert(
+                        block, _NullStats()
+                    )
+                    break
+                except MPTNodeMissingException as e:
+                    self._heal_missing_node(peer, e.hash)
+            else:
+                raise SyncAborted(
+                    f"block {block.header.number} kept failing after heals"
+                )
+            imported += 1
+            self.imported += 1
+        if imported:
+            self.log(
+                f"imported {imported} blocks, best now "
+                f"#{self.blockchain.best_block_number}"
+            )
+        return imported
+
+    def run(self, until: Callable[[], bool], poll: float = 0.2,
+            max_seconds: float = 60.0) -> None:
+        """Loop sync_once until ``until()`` or timeout (test harness /
+        node main-loop form)."""
+        deadline = time.time() + max_seconds
+        while not until():
+            if time.time() > deadline:
+                raise SyncAborted("regular sync timed out")
+            if self.sync_once() == 0:
+                time.sleep(poll)
+
+
+class _NullStats:
+    """ReplayDriver stats sink for single-block imports."""
+
+    blocks = txs = gas = parallel_txs = conflicts = 0
+
+    def __setattr__(self, k, v):  # stats increments land here harmlessly
+        object.__setattr__(self, k, v)

@@ -262,24 +262,18 @@ def finalize(
                 )
         except FusedUnsupported:
             resolved = {}
-    pending = {} if resolved else dict(deps)
-    while pending:
-        level = [
-            ph
-            for ph, children in pending.items()
-            if all(c in resolved for c in children)
-        ]
-        if not level:
-            raise AssertionError("placeholder dependency cycle")
-        encodings = []
-        for ph in level:
-            final = rlp_encode(_substitute(structures[ph], resolved))
-            encodings.append(final)
-        digests = hasher(encodings)
-        for ph, enc, digest in zip(level, encodings, digests):
-            resolved[ph] = digest
-            final_encoded[digest] = enc
-            del pending[ph]
+    if not resolved and deps:
+        from khipu_tpu.trie.fused import topo_levels
+
+        for level in topo_levels(deps):
+            encodings = []
+            for ph in level:
+                final = rlp_encode(_substitute(structures[ph], resolved))
+                encodings.append(final)
+            digests = hasher(encodings)
+            for ph, enc, digest in zip(level, encodings, digests):
+                resolved[ph] = digest
+                final_encoded[digest] = enc
 
     # rebuild logs: resolved placeholders become Updated(real) records;
     # removal records for pre-existing hashes pass through. Two

@@ -50,25 +50,26 @@ class FusedUnsupported(Exception):
     falls back to the per-level hasher loop)."""
 
 
-def dag_depth(deps: Dict[bytes, List[bytes]]) -> int:
-    """Height of the dependency DAG (leaves = 1). Raises AssertionError
-    on a cycle / unresolvable reference — same contract as the level
-    loop in deferred.finalize."""
-    depth: Dict[bytes, int] = {}
+def topo_levels(deps: Dict[bytes, List[bytes]]) -> List[List[bytes]]:
+    """Topological levels of the dependency DAG, leaves first. The ONE
+    implementation of level detection — deferred.finalize's hashing loop
+    and the fused fixpoint both consume it. Raises AssertionError on a
+    cycle / unresolvable reference."""
+    done: set = set()
     pending = dict(deps)
-    d = 0
+    levels: List[List[bytes]] = []
     while pending:
         level = [
             ph for ph, cs in pending.items()
-            if all(c in depth for c in cs)
+            if all(c in done for c in cs)
         ]
         if not level:
             raise AssertionError("placeholder dependency cycle")
-        d += 1
         for ph in level:
-            depth[ph] = d
+            done.add(ph)
             del pending[ph]
-    return d
+        levels.append(level)
+    return levels
 
 
 def _pow2(n: int, floor: int = 1) -> int:
@@ -165,7 +166,7 @@ def fused_resolve(
     """
     if not to_resolve:
         return {}
-    depth = dag_depth(deps)
+    depth = len(topo_levels(deps))
     if depth > MAX_DEPTH:
         raise FusedUnsupported(f"DAG depth {depth} > {MAX_DEPTH}")
 

@@ -1,0 +1,179 @@
+"""Fast-sync orchestration over real RLPx loopback peers.
+
+The verdict-7 scenario: pivot selection by MEDIAN best number over >= N
+peers, and a bounded-concurrency multi-peer node-download pool feeding
+StateSyncer — with one of three serving peers STALLING mid-download
+(request timeout -> blacklist -> work redistributed to the live peers).
+
+Parity: FastSyncService.scala:184-273 (pivot), :537-667 (scheduler).
+"""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.config import SyncConfig, fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.network.host_service import HostService
+from khipu_tpu.network.messages import (
+    ETH_OFFSET,
+    GET_NODE_DATA,
+    Status,
+)
+from khipu_tpu.network.peer import PeerManager
+from khipu_tpu.storage.compactor import verify_reachable
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.sync.fast_sync_service import FastSyncError, FastSyncService
+from khipu_tpu.sync.replay import ReplayDriver
+
+SENDER_KEY = (11).to_bytes(32, "big")
+SENDER = pubkey_to_address(privkey_to_pubkey(SENDER_KEY))
+ALLOC = {SENDER: 10**24}
+
+CFG = dataclasses.replace(
+    fixture_config(chain_id=1),
+    sync=SyncConfig(
+        parallel_tx=False, tx_workers=2, commit_window_blocks=1,
+        min_peers_to_choose_pivot=3, pivot_block_offset=3,
+        nodes_per_request=16, peer_request_timeout=1.0,
+    ),
+)
+
+
+def build_and_import(n_blocks=20):
+    builder = ChainBuilder(
+        Blockchain(Storages(), CFG), CFG, GenesisSpec(alloc=ALLOC)
+    )
+    blocks = []
+    for n in range(1, n_blocks + 1):
+        txs = [
+            sign_transaction(
+                Transaction(
+                    n - 1, 10**9, 21_000,
+                    bytes.fromhex("%040x" % (0xCAFE + n)), 1 + n,
+                ),
+                SENDER_KEY, chain_id=1,
+            )
+        ]
+        blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
+    bc = Blockchain(Storages(), CFG)
+    bc.load_genesis(GenesisSpec(alloc=ALLOC))
+    ReplayDriver(bc, CFG).replay(blocks)
+    return bc, blocks
+
+
+def make_status_factory(bc):
+    def make():
+        best = bc.best_block_number
+        return Status(
+            protocol_version=63, network_id=1,
+            total_difficulty=bc.get_total_difficulty(best) or 0,
+            best_hash=bc.get_hash_by_number(best),
+            genesis_hash=bc.get_hash_by_number(0),
+        )
+    return make
+
+
+@pytest.fixture
+def cluster():
+    """One source chain, three serving peers (one stallable), one
+    syncing client connected to all three over RLPx loopback."""
+    managers = []
+    bc, blocks = build_and_import(20)
+    stall = threading.Event()
+
+    servers = []
+    for i in range(3):
+        priv = (0x5E0 + i).to_bytes(32, "big")
+        m = PeerManager(priv, f"khipu-tpu/server{i}", make_status_factory(bc))
+        HostService(bc).install(m)
+        if i == 2:
+            # peer 2 can be switched into a stall: accepts the request,
+            # never answers (the reader thread sleeps through the
+            # client's timeout window)
+            real = m.handlers[ETH_OFFSET + GET_NODE_DATA]
+
+            def stalling(body, _real=real):
+                if stall.is_set():
+                    time.sleep(5.0)
+                    return None
+                return _real(body)
+
+            m.handlers[ETH_OFFSET + GET_NODE_DATA] = stalling
+        port = m.listen()
+        servers.append((m, port, privkey_to_pubkey(priv)))
+        managers.append(m)
+
+    syncer_bc = Blockchain(Storages(), CFG)
+    syncer_bc.load_genesis(GenesisSpec(alloc=ALLOC))
+    client = PeerManager(
+        (0xC11).to_bytes(32, "big"), "khipu-tpu/syncer",
+        make_status_factory(syncer_bc),
+    )
+    managers.append(client)
+    for m, port, pub in servers:
+        client.connect("127.0.0.1", port, pub)
+
+    yield bc, blocks, syncer_bc, client, stall
+    for m in managers:
+        m.stop()
+
+
+class TestFastSyncService:
+    def test_pivot_is_median_minus_offset(self, cluster):
+        bc, blocks, syncer_bc, client, stall = cluster
+        svc = FastSyncService(syncer_bc, CFG, client)
+        pivot = svc.choose_pivot()
+        # all peers serve the same chain: median best = 20, offset 3
+        assert pivot.number == 17
+        assert pivot.state_root == blocks[16].header.state_root
+
+    def test_pivot_requires_min_peers(self, cluster):
+        bc, blocks, syncer_bc, client, stall = cluster
+        # drop to 2 peers: below the configured minimum of 3
+        client.peers[0].disconnect()
+        svc = FastSyncService(syncer_bc, CFG, client)
+        with pytest.raises(FastSyncError, match="peers"):
+            svc.choose_pivot()
+
+    def test_full_fast_sync_with_stalling_peer(self, cluster):
+        bc, blocks, syncer_bc, client, stall = cluster
+        logs = []
+        svc = FastSyncService(syncer_bc, CFG, client, log=logs.append)
+        stall.set()  # peer 2 stalls every node-data request
+        state = svc.run()
+
+        # the stalling peer was blacklisted and the download finished
+        # from the other two
+        assert svc.pool.blacklisted == 1
+        assert client.blacklist.is_blacklisted(client.peers[2].remote_pub)
+        assert state.downloaded_nodes > 20
+
+        pivot_n = 20 - CFG.sync.pivot_block_offset
+        # block data backfilled to the pivot
+        assert syncer_bc.best_block_number == pivot_n
+        assert (
+            syncer_bc.get_hash_by_number(pivot_n)
+            == blocks[pivot_n - 1].hash
+        )
+        # the downloaded state trie is COMPLETE at the pivot root
+        root = blocks[pivot_n - 1].header.state_root
+        report = verify_reachable(
+            syncer_bc.storages.account_node_storage,
+            syncer_bc.storages.storage_node_storage,
+            syncer_bc.storages.evmcode_storage,
+            root,
+        )
+        assert report.missing == 0
+        # spot-check an account through the world at the pivot
+        w = syncer_bc.get_world_state(root)
+        assert w.get_balance(SENDER) > 0
+        assert syncer_bc.storages.app_state.fast_sync_done

@@ -1,0 +1,227 @@
+"""Live regular sync over real RLPx loopback sockets.
+
+The verdict-6 scenario: a fresh node regular-syncs a 50-block chain from
+a serving peer END TO END — RLPx auth, Hello/Status, batched header +
+body fetch, full validated import — including one reorg (the serving
+node switches to a higher-TD branch mid-sync and the syncer rolls back
+to the common ancestor), and one missing-node heal through GetNodeData.
+
+Parity: RegularSyncService.scala:103-269 (fetch loop), :336-345 (TD
+reorg), :448-479 (best peer); HostService.scala (the serving side).
+"""
+
+import dataclasses
+import threading
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.config import SyncConfig, fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.network.host_service import HostService
+from khipu_tpu.network.messages import Status
+from khipu_tpu.network.peer import PeerManager
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.sync.regular_sync import RegularSyncService
+from khipu_tpu.sync.replay import ReplayDriver
+
+PRIV_A = (0xA11CE).to_bytes(32, "big")
+PRIV_B = (0xB0B).to_bytes(32, "big")
+SENDER_KEY = (7).to_bytes(32, "big")
+SENDER = pubkey_to_address(privkey_to_pubkey(SENDER_KEY))
+ALLOC = {SENDER: 10**24}
+
+CFG = dataclasses.replace(
+    fixture_config(chain_id=1),
+    sync=SyncConfig(
+        parallel_tx=False, tx_workers=2, commit_window_blocks=1,
+        block_resolving_depth=20,
+    ),
+)
+
+
+def build_chain(n_blocks, diverge_at=None, fork_coinbase=b"\xbb" * 20):
+    """Deterministic fixture chain; identical prefixes across calls.
+    From ``diverge_at`` on, blocks use a different coinbase (a distinct
+    but equally valid branch)."""
+    builder = ChainBuilder(
+        Blockchain(Storages(), CFG), CFG, GenesisSpec(alloc=ALLOC)
+    )
+    blocks = []
+    nonce = 0
+    for n in range(1, n_blocks + 1):
+        coinbase = (
+            fork_coinbase
+            if diverge_at is not None and n >= diverge_at
+            else b"\xaa" * 20
+        )
+        txs = [
+            sign_transaction(
+                Transaction(
+                    nonce, 10**9, 21_000,
+                    bytes.fromhex("%040x" % (0xD00D + n)), 1,
+                ),
+                SENDER_KEY, chain_id=1,
+            )
+        ]
+        nonce += 1
+        blocks.append(builder.add_block(txs, coinbase=coinbase))
+    return blocks
+
+
+def make_serving_node(blocks):
+    """A blockchain with ``blocks`` imported, ready to serve."""
+    bc = Blockchain(Storages(), CFG)
+    bc.load_genesis(GenesisSpec(alloc=ALLOC))
+    ReplayDriver(bc, CFG).replay(blocks)
+    return bc
+
+
+class _NodeBox:
+    """Mutable holder so the server can switch chains mid-test."""
+
+    def __init__(self, bc):
+        self.bc = bc
+
+
+def status_factory(box: _NodeBox):
+    def make():
+        bc = box.bc
+        best = bc.best_block_number
+        return Status(
+            protocol_version=63,
+            network_id=1,
+            total_difficulty=bc.get_total_difficulty(best) or 0,
+            best_hash=bc.get_hash_by_number(best),
+            genesis_hash=bc.get_hash_by_number(0),
+        )
+    return make
+
+
+class _SwitchingHost(HostService):
+    """HostService over a switchable chain box."""
+
+    def __init__(self, box: _NodeBox):
+        self.box = box
+
+    @property
+    def blockchain(self):
+        return self.box.bc
+
+    @blockchain.setter
+    def blockchain(self, v):  # HostService.__init__ assigns; ignore
+        pass
+
+
+@pytest.fixture
+def loopback():
+    managers = []
+
+    def connect(server_box, client_box):
+        server = PeerManager(
+            PRIV_A, "khipu-tpu/server", status_factory(server_box)
+        )
+        _SwitchingHost(server_box).install(server)
+        port = server.listen()
+        client = PeerManager(
+            PRIV_B, "khipu-tpu/client", status_factory(client_box)
+        )
+        peer = client.connect("127.0.0.1", port, privkey_to_pubkey(PRIV_A))
+        managers.extend([server, client])
+        return server, client, peer
+
+    yield connect
+    for m in managers:
+        m.stop()
+
+
+class TestRegularSync:
+    def test_fresh_node_syncs_50_blocks_with_reorg(self, loopback):
+        chain1 = build_chain(30)
+        chain2 = build_chain(50, diverge_at=26)
+        assert chain1[24].hash == chain2[24].hash  # shared prefix
+        assert chain1[25].hash != chain2[25].hash  # divergence
+
+        server_box = _NodeBox(make_serving_node(chain1))
+        syncer_bc = Blockchain(Storages(), CFG)
+        syncer_bc.load_genesis(GenesisSpec(alloc=ALLOC))
+        client_box = _NodeBox(syncer_bc)
+        server, client, peer = loopback(server_box, client_box)
+
+        sync = RegularSyncService(syncer_bc, CFG, client, batch_size=7)
+
+        # phase 1: catch up to the serving node's 30-block chain
+        sync.run(until=lambda: syncer_bc.best_block_number >= 30,
+                 max_seconds=60)
+        assert syncer_bc.best_block_number == 30
+        assert syncer_bc.get_hash_by_number(30) == chain1[-1].hash
+        assert sync.reorgs == 0
+
+        # phase 2: the peer switches to a longer (higher-TD) branch that
+        # diverges at #26 — the syncer must roll back and adopt it
+        server_box.bc = make_serving_node(chain2)
+        sync.run(until=lambda: syncer_bc.best_block_number >= 50,
+                 max_seconds=60)
+        assert syncer_bc.best_block_number == 50
+        assert syncer_bc.get_hash_by_number(50) == chain2[-1].hash
+        assert syncer_bc.get_hash_by_number(26) == chain2[25].hash
+        assert sync.reorgs == 1
+        assert sync.imported >= 50 + 5  # 30 + 25 re-imported
+        # the orphaned branch is gone from the canonical index
+        assert syncer_bc.get_header_by_hash(chain1[-1].hash) is None
+
+    def test_lower_td_branch_is_rejected(self, loopback):
+        chain1 = build_chain(30)
+        short_fork = build_chain(27, diverge_at=26)
+
+        server_box = _NodeBox(make_serving_node(chain1))
+        syncer_bc = Blockchain(Storages(), CFG)
+        syncer_bc.load_genesis(GenesisSpec(alloc=ALLOC))
+        client_box = _NodeBox(syncer_bc)
+        server, client, peer = loopback(server_box, client_box)
+
+        sync = RegularSyncService(syncer_bc, CFG, client, batch_size=7)
+        sync.run(until=lambda: syncer_bc.best_block_number >= 30,
+                 max_seconds=60)
+
+        # peer switches to a SHORTER branch: its status TD is lower, so
+        # the syncer must not move at all
+        server_box.bc = make_serving_node(short_fork)
+        assert sync.sync_once() == 0
+        assert syncer_bc.best_block_number == 30
+        assert syncer_bc.get_hash_by_number(30) == chain1[-1].hash
+        assert sync.reorgs == 0
+
+    def test_missing_node_heals_through_peer(self, loopback):
+        chain = build_chain(12)
+        server_box = _NodeBox(make_serving_node(chain))
+        syncer_bc = Blockchain(Storages(), CFG)
+        syncer_bc.load_genesis(GenesisSpec(alloc=ALLOC))
+        client_box = _NodeBox(syncer_bc)
+        server, client, peer = loopback(server_box, client_box)
+
+        sync = RegularSyncService(syncer_bc, CFG, client, batch_size=4)
+        sync.run(until=lambda: syncer_bc.best_block_number >= 8,
+                 max_seconds=60)
+
+        # vandalize: drop the current state root node from the syncer's
+        # account store (cache + backing dict), as a crash/partial-write
+        # would; the next import must heal it from the peer
+        root = syncer_bc.get_header_by_number(8).state_root
+        ns = syncer_bc.storages.account_node_storage
+        ns._cache.remove(root)
+        ns._unconfirmed.source._map.pop(root, None)
+        dcache = getattr(ns, "_mpt_dcache", None)
+        if dcache is not None:
+            dcache.pop(root, None)
+
+        sync.run(until=lambda: syncer_bc.best_block_number >= 12,
+                 max_seconds=60)
+        assert syncer_bc.best_block_number == 12
+        assert sync.healed_nodes >= 1
+        assert ns.get(root) is not None  # healed back into the store
