@@ -102,6 +102,12 @@ class EvmConfig:
     constantinople: bool = False  # shifts, CREATE2, EXTCODEHASH
     petersburg: bool = False  # disables EIP-1283
     istanbul: bool = False  # EIP-2200 SSTORE, CHAINID, SELFBALANCE
+    # mainnet block 2,675,119 compat (EvmConfig.scala:111-118 +
+    # OpCode.scala:1425-1436): a FAILED call to the RIPEMD-160
+    # precompile still records the touch, so the empty 0x..03 account is
+    # deleted even though the frame reverted (the Parity EIP-161 bug the
+    # canonical chain embeds)
+    eip161_patch: bool = False
 
     # ------------------------------------------------ derived semantics
 
@@ -144,7 +150,8 @@ class EvmConfig:
 @lru_cache(maxsize=512)
 def _build(flags: tuple, chain_id: int, start_nonce: int, max_code: int) -> EvmConfig:
     (homestead, eip150, eip155, eip160, eip161,
-     eip170, byzantium, constantinople, petersburg, istanbul) = flags
+     eip170, byzantium, constantinople, petersburg, istanbul,
+     eip161_patch) = flags
     if istanbul:
         fees = _ISTANBUL_FEES
     elif eip160:
@@ -168,25 +175,27 @@ def _build(flags: tuple, chain_id: int, start_nonce: int, max_code: int) -> EvmC
         constantinople=constantinople,
         petersburg=petersburg,
         istanbul=istanbul,
+        eip161_patch=eip161_patch,
     )
 
 
 def for_block(number: int, bc: BlockchainConfig) -> EvmConfig:
     """EvmConfig.forBlock(:19-37): pick the fork config active at a
-    block. The EIP-161 patch blocks (EvmConfig.scala:111-118) disable
-    empty-account clearing for exactly those block numbers."""
-    eip161 = number >= bc.eip161_block and number != bc.eip161_patch_block
+    block. At exactly the EIP-161 patch block (EvmConfig.scala:111-118,
+    mainnet 2,675,119) the ripemd touch-survives-revert compat rule is
+    active; EIP-161 clearing itself stays on."""
     flags = (
         number >= bc.homestead_block,
         number >= bc.eip150_block,
         number >= bc.eip155_block,
         number >= bc.eip160_block,
-        eip161,
+        number >= bc.eip161_block,
         number >= bc.eip170_block,
         number >= bc.byzantium_block,
         number >= bc.constantinople_block,
         number >= bc.petersburg_block,
         number >= bc.istanbul_block,
+        number == bc.eip161_patch_block,
     )
     return _build(
         flags, bc.chain_id, bc.account_start_nonce, bc.max_code_size

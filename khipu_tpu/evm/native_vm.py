@@ -334,6 +334,7 @@ def _pack_config(config: EvmConfig):
             int(config.byzantium),
             int(config.constantinople),
             int(config.istanbul),
+            int(config.eip161_patch),
         ] + [getattr(config.fees, f) for f in FEE_FIELDS]
         arr = (C.c_uint64 * len(vals))(*vals)
         _cfg_cache[config] = arr

@@ -34,6 +34,7 @@ from khipu_tpu.evm.program import Program
 from khipu_tpu.evm.stack import Stack, StackError
 
 MAX_CALL_DEPTH = 1024
+RIPEMD_ADDR = b"\x00" * 19 + b"\x03"
 
 # Opcode-level trace hook (debug-trace-at, VM.scala:40-57): set by the
 # ledger around a traced block (which runs sequentially, so a module
@@ -691,6 +692,16 @@ def _mk_call(kind):
         result = _execute_message(
             st.config, child_world, st.block, env, code, child_gas, to
         )
+        if (
+            not result.ok
+            and st.config.eip161_patch
+            and to == RIPEMD_ADDR
+        ):
+            # mainnet #2,675,119 compat (OpCode.scala:1425-1436): the
+            # failed frame's touch of the ripemd precompile SURVIVES
+            # into the parent, so the empty 0x..03 account is deleted
+            # at tx end despite the revert
+            st.world.touch(to)
         _finish_child(st, result, out_off, out_size, result.world)
         st.pc += 1
 

@@ -417,7 +417,7 @@ enum Fee {
 enum Cfg {
   C_chain_id, C_start_nonce, C_contract_start_nonce, C_max_code_size,
   C_homestead, C_eip150, C_eip161, C_eip170, C_byzantium,
-  C_constantinople, C_istanbul, C_FEES0  // fees follow
+  C_constantinople, C_istanbul, C_eip161_patch, C_FEES0  // fees follow
 };
 
 typedef int (*cb_exists_t)(void*, const uint8_t*);
@@ -1171,6 +1171,14 @@ static void op_call_family(Frame& f, CallKind kind) {
   if (r.status != OK) {  // revert or error: discard the child's writes
     tx.frame = std::move(saved);
     tx.oplog.resize(oplog_mark);
+    // mainnet #2,675,119 compat (OpCode.scala:1425-1436): a failed
+    // call to the ripemd precompile keeps its touch in the parent
+    if (tx.flag(C_eip161_patch)) {
+      bool is_ripemd = to[19] == 0x03;
+      for (int i = 0; i < 19 && is_ripemd; ++i)
+        if (to[i] != 0) is_ripemd = false;
+      if (is_ripemd) w_touch(tx, to);
+    }
   }
   finish_child(f, r, out_off, out_size);
   f.pc += 1;

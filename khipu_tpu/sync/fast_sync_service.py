@@ -22,7 +22,7 @@ from typing import Callable, Dict, List, Mapping, Optional
 
 from khipu_tpu.base.crypto.keccak import keccak256
 from khipu_tpu.config import KhipuConfig
-from khipu_tpu.domain.block import Block, BlockBody
+from khipu_tpu.domain.block import BlockBody
 from khipu_tpu.domain.block_header import BlockHeader
 from khipu_tpu.domain.blockchain import Blockchain
 from khipu_tpu.domain.receipt import Receipt, encode_receipts
@@ -204,12 +204,10 @@ class FastSyncService:
                 f"have {len(peers)}"
             )
         bests: List[int] = []
-        by_number: Dict[int, Peer] = {}
         for p in peers:
             h = self._best_header_of(p)
             if h is not None:
                 bests.append(h.number)
-                by_number[h.number] = p
         if len(bests) < self.min_peers:
             raise FastSyncError(
                 f"only {len(bests)}/{self.min_peers} peers answered the "
@@ -304,28 +302,32 @@ class FastSyncService:
         raise FastSyncError(f"no peer served headers [{start}..+{count})")
 
     def _bodies_of(self, hashes: List[bytes]) -> List[BlockBody]:
+        # EXACT counts only: replies carry no correlation and servers
+        # skip unknown hashes, so a short reply would silently shift
+        # every later header/body pair — try the next peer instead
         out: List[BlockBody] = []
         want = list(hashes)
         while want:
+            chunk = want[:20]
             served = False
             for peer in self.pool._live_peers():
                 try:
                     body = peer.request(
                         ETH_OFFSET + GET_BLOCK_BODIES,
-                        want[:20],
+                        chunk,
                         ETH_OFFSET + BLOCK_BODIES,
                         timeout=self.pool.timeout,
                     )
                 except PeerError:
                     continue
                 got = decode_bodies(body)
-                if got:
+                if len(got) == len(chunk):
                     out.extend(got)
-                    want = want[len(got) :]
+                    want = want[len(chunk) :]
                     served = True
                     break
             if not served:
-                raise FastSyncError("no peer served bodies")
+                raise FastSyncError("no peer served the full body chunk")
         return out
 
     def _receipts_of(self, hashes: List[bytes]) -> List[List[Receipt]]:
@@ -335,26 +337,27 @@ class FastSyncService:
         out: List[List[Receipt]] = []
         want = list(hashes)
         while want:
+            chunk = want[:5]
             served = False
             for peer in self.pool._live_peers():
                 try:
                     body = peer.request(
                         ETH_OFFSET + GET_RECEIPTS,
-                        want[:5],
+                        chunk,
                         ETH_OFFSET + RECEIPTS,
                         timeout=self.pool.timeout,
                     )
                 except PeerError:
                     continue
-                if body:
+                if len(body) == len(chunk):
                     out.extend(
                         decode_receipts(rlp_encode(item)) for item in body
                     )
-                    want = want[len(body) :]
+                    want = want[len(chunk) :]
                     served = True
                     break
             if not served:
-                raise FastSyncError("no peer served receipts")
+                raise FastSyncError("no peer served the full receipt chunk")
         return out
 
     # ------------------------------------------------------------- driver

@@ -108,8 +108,15 @@ class RegularSyncService:
         while want:
             batch = want[: self.batch_size]
             bodies = self._request_bodies(peer, [h.hash for h in batch])
-            if not bodies:
-                raise PeerError("peer returned no bodies")
+            if len(bodies) != len(batch):
+                # BlockBodies carries no correlation and the serving
+                # side silently SKIPS unknown hashes — a short reply
+                # would shift every later header/body pair, so a count
+                # mismatch ends the round (next round refetches fresh
+                # headers; an honest mid-reorg peer recovers there)
+                raise PeerError(
+                    f"peer served {len(bodies)}/{len(batch)} bodies"
+                )
             for header, body in zip(batch, bodies):
                 if transactions_root(body.transactions) != header.transactions_root:
                     raise PeerError("body txRoot mismatch")
@@ -153,9 +160,13 @@ class RegularSyncService:
     def _maybe_reorg(
         self, branch: List[BlockHeader], ancestor: BlockHeader
     ) -> Optional[List[BlockHeader]]:
-        """Adopt the branch iff its cumulative TD beats ours
+        """Accept the branch iff its cumulative TD beats ours AND every
+        branch header passes full validation against its parent
         (appendNewBlock TD rule, RegularSyncService.scala:336-345).
-        Rolls our chain back to the ancestor on adoption."""
+        Validating BEFORE any rollback means a peer cannot knock us off
+        our tip with invented difficulty fields — the rollback itself
+        happens only after the branch's bodies are also in hand
+        (_sync_round)."""
         ancestor_td = self.blockchain.get_total_difficulty(ancestor.number)
         if ancestor_td is None:
             return None
@@ -168,21 +179,27 @@ class RegularSyncService:
                 f"({branch_td} <= {our_td}); keeping our chain"
             )
             return None
-        # roll back to the common ancestor
-        n = our_best
-        while n > ancestor.number:
+        parent = ancestor
+        for h in branch:
+            try:
+                self._driver.header_validator.validate(h, parent)
+            except Exception as e:
+                raise PeerError(f"branch header #{h.number} invalid: {e}")
+            parent = h
+        return branch
+
+    def _rollback_to(self, ancestor_number: int) -> None:
+        """Remove our blocks above the common ancestor (reorg adoption;
+        called only once the replacement blocks are fully fetched)."""
+        n = self.blockchain.best_block_number
+        while n > ancestor_number:
             header = self.blockchain.get_header_by_number(n)
             if header is None:
                 break
             self.blockchain.remove_block(header.hash)
             n -= 1
-        self.blockchain.storages.app_state.best_block_number = ancestor.number
+        self.blockchain.storages.app_state.best_block_number = ancestor_number
         self.reorgs += 1
-        self.log(
-            f"reorg to peer branch at #{ancestor.number} "
-            f"(td {branch_td} > {our_td}, {len(branch)} blocks)"
-        )
-        return branch
 
     # ----------------------------------------------------------- healing
 
@@ -221,34 +238,55 @@ class RegularSyncService:
         # response decide (RegularSyncService.ResumeRegularSyncTask);
         # TD only picks the peer and judges branches.
         try:
-            headers = self._request_headers(
-                peer, our_best + 1, self.batch_size
-            )
-        except PeerError:
+            return self._sync_round(peer, our_best, our_td)
+        except PeerError as e:
+            # ANY wire/protocol failure mid-round (disconnect, timeout,
+            # mismatched body, garbage headers) demotes the peer and
+            # ends the round — the loop carries on with other peers
+            self.log(f"peer failed mid-round: {e}")
             self.manager.blacklist.add(peer.remote_pub, duration=60.0)
             peer.disconnect()
             return 0
+
+    def _sync_round(self, peer: Peer, our_best: int, our_td: int) -> int:
+        headers = self._request_headers(peer, our_best + 1, self.batch_size)
         if not headers:
             if peer.status.total_difficulty <= our_td:
                 return 0  # nothing new and no TD claim: at the tip
-            # the peer claims higher TD but serves nothing at our tip+1:
-            # its chain forked below our best — probe backward from its
-            # best hash like the branch resolver would
+            # the peer claims higher TD but serves nothing past our tip:
+            # its (heavier) chain is no longer than ours — fetch ITS
+            # canonical headers ending at our best height and resolve
+            # the branch from there
             headers = self._request_headers(
-                peer, peer.status.best_hash, self.batch_size, reverse=True
+                peer, our_best, self.batch_size, reverse=True
             )
             if not headers:
                 return 0
             headers = list(reversed(headers))
+            if headers[-1].hash == self.blockchain.get_hash_by_number(
+                headers[-1].number
+            ):
+                return 0  # same chain after all — nothing to adopt
 
         tip = self.blockchain.get_hash_by_number(our_best)
+        is_reorg = False
         if headers[0].parent_hash != tip:
             resolved = self._resolve_branch(peer, headers)
             if resolved is None:
                 return 0
             headers = resolved
+            is_reorg = True
 
+        # bodies BEFORE any rollback: a reorg only touches our chain
+        # once the replacement blocks are fully fetched and checked
         blocks = self._fetch_blocks(peer, headers)
+        if is_reorg:
+            ancestor_number = headers[0].number - 1
+            self._rollback_to(ancestor_number)
+            self.log(
+                f"reorg: rolled back to #{ancestor_number}, adopting "
+                f"{len(headers)} peer blocks"
+            )
         imported = 0
         for block in blocks:
             for attempt in range(3):

@@ -318,6 +318,93 @@ class TestBN128:
         assert b.pairing_check(b"\x00" * 191) is None
 
 
+class TestBN128ExternalVectors:
+    """EIP-196/197 anchors built ONLY from constants printed in the EIP
+    texts (not from this implementation): the G1/G2 generator
+    coordinates, the curve order n, the field prime p, and the
+    universally published doubling 2*G1. A sign/limb/encoding bug in
+    evm/bn128.py cannot survive these (the bilinearity tests above are
+    self-consistent and could)."""
+
+    # EIP-196 spec constants
+    P = 21888242871839275222246405745257275088696311157297823662689037894645226208583  # noqa: E501
+    N = 21888242871839275222246405745257275088548364400416034343698204186575808495617  # noqa: E501
+    # 2*G1, derived IN THIS TEST MODULE from the spec constants alone
+    # (affine doubling on y^2 = x^3 + 3 over F_p at G = (1,2)) — an
+    # oracle independent of evm/bn128.py's Jacobian/tower code paths
+    _LAM = (3 * pow(4, -1, P)) % P
+    TWO_G_X = (_LAM * _LAM - 2) % P
+    TWO_G_Y = (_LAM * (1 - TWO_G_X) - 2) % P
+    assert (TWO_G_Y**2 - (TWO_G_X**3 + 3)) % P == 0
+    # EIP-197 G2 generator (Fp2 elements c0 + c1*i); wire order is
+    # imaginary-first: (x_c1, x_c0, y_c1, y_c0)
+    G2X_C0 = 10857046999023057135944570762232829481370756359578518086990519993285655852781  # noqa: E501
+    G2X_C1 = 11559732032986387107991004021392285783925812861821192530917403151452391805634  # noqa: E501
+    G2Y_C0 = 8495653923123431417604973247489272438418190587263600148770280649306958101930  # noqa: E501
+    G2Y_C1 = 4082367875863433681332203403145435568316851327593401208105741076214120093531  # noqa: E501
+
+    def _call(self, addr_byte, data):
+        from khipu_tpu.evm.precompiles import get_precompile
+
+        p = get_precompile(b"\x00" * 19 + bytes([addr_byte]), CFG)
+        gas_fn, run_fn = p
+        gas_fn(data, CFG)
+        return run_fn(data)
+
+    @staticmethod
+    def _w(*vals):
+        return b"".join(v.to_bytes(32, "big") for v in vals)
+
+    def test_ecadd_doubling_vector(self):
+        out = self._call(0x6, self._w(1, 2, 1, 2))
+        assert out == self._w(self.TWO_G_X, self.TWO_G_Y)
+
+    def test_ecmul_by_two_vector(self):
+        out = self._call(0x7, self._w(1, 2, 2))
+        assert out == self._w(self.TWO_G_X, self.TWO_G_Y)
+
+    def test_ecmul_by_group_order_is_infinity(self):
+        out = self._call(0x7, self._w(1, 2, self.N))
+        assert out == self._w(0, 0)
+
+    def test_ecadd_inverse_points_is_infinity(self):
+        # (1, 2) + (1, p-2) = O  — the negation rule comes from the
+        # field prime, an EIP constant
+        out = self._call(0x6, self._w(1, 2, 1, self.P - 2))
+        assert out == self._w(0, 0)
+
+    def test_ecadd_identity(self):
+        assert self._call(0x6, self._w(1, 2, 0, 0)) == self._w(1, 2)
+
+    def test_invalid_point_rejected(self):
+        # (1, 3) is not on y^2 = x^3 + 3
+        assert self._call(0x6, self._w(1, 3, 1, 2)) is None
+        assert self._call(0x7, self._w(1, 3, 5)) is None
+
+    def test_pairing_generator_vector(self):
+        """e(G1, G2) * e(-G1, G2) == 1 with the SPEC's G2 coordinates in
+        the SPEC's imaginary-first wire order — pins both the tower
+        arithmetic and the Fp2 encoding convention."""
+        g2 = self._w(self.G2X_C1, self.G2X_C0, self.G2Y_C1, self.G2Y_C0)
+        data = self._w(1, 2) + g2 + self._w(1, self.P - 2) + g2
+        assert self._call(0x8, data) == self._w(1)
+        # a single generator pair is NOT the identity
+        assert self._call(0x8, self._w(1, 2) + g2) == self._w(0)
+
+    def test_pairing_bilinearity_cross_vector(self):
+        """e(2*G1, G2) == e(G1, G2)^2 == e(G1, 2*G2): check via the
+        product e(2G1, G2) * e(-G1, G2) * e(-G1, G2) == 1, using the
+        published 2*G1 value rather than our own arithmetic."""
+        g2 = self._w(self.G2X_C1, self.G2X_C0, self.G2Y_C1, self.G2Y_C0)
+        neg_g1 = self._w(1, self.P - 2)
+        data = (
+            self._w(self.TWO_G_X, self.TWO_G_Y) + g2
+            + neg_g1 + g2
+            + neg_g1 + g2
+        )
+        assert self._call(0x8, data) == self._w(1)
+
+
 def _deploy_helper(world, addr, runtime):
     """Install runtime code + account directly for frame-semantics tests."""
     from khipu_tpu.domain.account import Account
@@ -467,3 +554,83 @@ class TestCallFrames:
         assert int.from_bytes(r2.output, "big") == 0  # CALL status 0
         # child gas came back: only the frame's own ops were paid
         assert r2.gas_remaining > 90_000
+
+
+class TestEIP161TouchSurvivesRevert:
+    """Mainnet #2,675,119 compat (EvmConfig.scala:111-118 +
+    OpCode.scala:1425-1436): at exactly the configured patch block, a
+    FAILED call to the RIPEMD-160 precompile still counts as a touch,
+    so the pre-existing empty 0x..03 account is deleted at tx end; at
+    every other post-EIP-161 block the revert erases the touch and the
+    account survives. Checked on both VM backends."""
+
+    RIPEMD = b"\x00" * 19 + b"\x03"
+
+    def _run(self, patched: bool, backend: str):
+        import dataclasses
+
+        from khipu_tpu.base.crypto.secp256k1 import (
+            privkey_to_pubkey,
+            pubkey_to_address,
+        )
+        from khipu_tpu.domain.account import Account
+        from khipu_tpu.domain.transaction import (
+            Transaction,
+            sign_transaction,
+        )
+        from khipu_tpu.evm import dispatch
+        from khipu_tpu.ledger.ledger import execute_transaction
+        from khipu_tpu.evm.config import for_block
+
+        base = fixture_config(chain_id=1)
+        bc = dataclasses.replace(
+            base.blockchain, eip161_patch_block=100 if patched else 10**18
+        )
+        config = for_block(100, bc)
+        assert config.eip161 and config.eip161_patch == patched
+
+        key = (3).to_bytes(32, "big")
+        sender = pubkey_to_address(privkey_to_pubkey(key))
+        world = fresh_world()
+        world.save_account(sender, Account(nonce=0, balance=10**18))
+        # the empty ripemd account EXISTS (as it did on mainnet)
+        world.save_account(self.RIPEMD, Account(nonce=0, balance=0))
+        caller = b"\x77" * 20
+        # CALL(gas=5, to=0x03, ...): 5 gas < ripemd's 600+ -> the
+        # precompile frame fails with OOG
+        code = bytes(
+            [0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00,
+             0x60, 0x03, 0x60, 0x05, 0xF1, 0x00]
+        )
+        world.save_account(caller, Account(nonce=1))
+        world.save_code(caller, code)
+        world.persist(
+            world.account_trie.source, world.storage_source,
+            world.evmcode_source,
+        )
+        world.touched.clear()
+        for cat in world.written:
+            world.written[cat].clear()
+
+        from khipu_tpu.evm.vm import BlockEnv
+
+        block = BlockEnv(100, 1000, 131072, 8_000_000, b"\xaa" * 20)
+        stx = sign_transaction(
+            Transaction(0, 1, 100_000, caller, 0), key, chain_id=1
+        )
+        dispatch.set_backend(backend)
+        try:
+            r = execute_transaction(config, world, block, stx, sender)
+        finally:
+            dispatch.set_backend(None)
+        assert r.status == 1  # the OUTER tx succeeds; only the sub-call failed
+        return r.world.get_account(self.RIPEMD)
+
+    @pytest.mark.parametrize("backend", ["python", "native"])
+    def test_patch_block_deletes_empty_ripemd(self, backend):
+        assert self._run(patched=True, backend=backend) is None
+
+    @pytest.mark.parametrize("backend", ["python", "native"])
+    def test_normal_block_reverts_the_touch(self, backend):
+        acc = self._run(patched=False, backend=backend)
+        assert acc is not None and acc.is_empty
