@@ -30,6 +30,7 @@ class Miner:
         coinbase: bytes,
         ethash_cache: Optional[EthashCache] = None,
         full_size: Optional[int] = None,
+        peer_manager=None,
     ):
         self.blockchain = blockchain
         self.config = config
@@ -37,6 +38,9 @@ class Miner:
         self.coinbase = coinbase
         self.cache = ethash_cache  # None = seal-less (dev chains)
         self.full_size = full_size
+        # with a peer manager, every sealed block is pushed to peers
+        # (BroadcastNewBlocks role, RegularSyncService.scala:306)
+        self.peer_manager = peer_manager
         self._builder = ChainBuilder.from_head(blockchain, config)
 
     def _select_txs(self) -> List:
@@ -93,4 +97,9 @@ class Miner:
             self._builder.head = sealed
             block = sealed
         self.tx_pool.remove_mined(block.body.transactions)
+        if self.peer_manager is not None:
+            from khipu_tpu.sync.regular_sync import broadcast_new_block
+
+            td = self.blockchain.get_total_difficulty(block.number) or 0
+            broadcast_new_block(self.peer_manager, block, td)
         return block

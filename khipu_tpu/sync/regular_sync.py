@@ -16,6 +16,7 @@ live-synced block passes exactly the gates a replayed one does.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, List, Optional
 
@@ -31,10 +32,13 @@ from khipu_tpu.network.messages import (
     GET_BLOCK_BODIES,
     GET_BLOCK_HEADERS,
     GET_NODE_DATA,
+    NEW_BLOCK,
     NODE_DATA,
     GetBlockHeaders,
     decode_bodies,
     decode_headers,
+    decode_new_block,
+    encode_new_block,
 )
 from khipu_tpu.network.peer import Peer, PeerError, PeerManager
 from khipu_tpu.sync.replay import ReplayDriver
@@ -69,6 +73,9 @@ class RegularSyncService:
         self._driver = ReplayDriver(
             blockchain, config, device_commit=device_commit
         )
+        # serializes chain mutation between the pull loop and the
+        # NewBlock push handler (which runs on peer reader threads)
+        self._import_lock = threading.Lock()
         self.imported = 0
         self.reorgs = 0
         self.healed_nodes = 0
@@ -239,10 +246,14 @@ class RegularSyncService:
         # TD only picks the peer and judges branches.
         try:
             return self._sync_round(peer, our_best, our_td)
-        except PeerError as e:
-            # ANY wire/protocol failure mid-round (disconnect, timeout,
-            # mismatched body, garbage headers) demotes the peer and
-            # ends the round — the loop carries on with other peers
+        except Exception as e:  # noqa: BLE001
+            # ANY failure mid-round — wire/protocol (disconnect,
+            # timeout, mismatched body, garbage headers) or an import
+            # error in an adopted branch — demotes the peer and ends
+            # the round; the loop carries on with other peers (the
+            # reference's actor restarts play the same role). A branch
+            # that failed AFTER rollback leaves us at the ancestor;
+            # later rounds sync forward again from there.
             self.log(f"peer failed mid-round: {e}")
             self.manager.blacklist.add(peer.remote_pub, duration=60.0)
             peer.disconnect()
@@ -254,12 +265,18 @@ class RegularSyncService:
             if peer.status.total_difficulty <= our_td:
                 return 0  # nothing new and no TD claim: at the tip
             # the peer claims higher TD but serves nothing past our tip:
-            # its (heavier) chain is no longer than ours — fetch ITS
-            # canonical headers ending at our best height and resolve
-            # the branch from there
-            headers = self._request_headers(
-                peer, our_best, self.batch_size, reverse=True
-            )
+            # its (heavier) chain is SHORTER than ours. Probe DOWNWARD —
+            # the peer has no header at our height either when its best
+            # is below ours, so descend until it serves a batch
+            # (bounded by the branch-resolving depth)
+            headers = []
+            probe = our_best
+            floor = max(1, our_best - self.config.sync.block_resolving_depth)
+            while probe >= floor and not headers:
+                headers = self._request_headers(
+                    peer, probe, self.batch_size, reverse=True
+                )
+                probe -= self.batch_size
             if not headers:
                 return 0
             headers = list(reversed(headers))
@@ -280,29 +297,31 @@ class RegularSyncService:
         # bodies BEFORE any rollback: a reorg only touches our chain
         # once the replacement blocks are fully fetched and checked
         blocks = self._fetch_blocks(peer, headers)
-        if is_reorg:
-            ancestor_number = headers[0].number - 1
-            self._rollback_to(ancestor_number)
-            self.log(
-                f"reorg: rolled back to #{ancestor_number}, adopting "
-                f"{len(headers)} peer blocks"
-            )
         imported = 0
-        for block in blocks:
-            for attempt in range(3):
-                try:
-                    self._driver._execute_and_insert(
-                        block, _NullStats()
-                    )
-                    break
-                except MPTNodeMissingException as e:
-                    self._heal_missing_node(peer, e.hash)
-            else:
-                raise SyncAborted(
-                    f"block {block.header.number} kept failing after heals"
+        with self._import_lock:  # excludes the NewBlock push handler
+            if is_reorg:
+                ancestor_number = headers[0].number - 1
+                self._rollback_to(ancestor_number)
+                self.log(
+                    f"reorg: rolled back to #{ancestor_number}, adopting "
+                    f"{len(headers)} peer blocks"
                 )
-            imported += 1
-            self.imported += 1
+            for block in blocks:
+                for attempt in range(3):
+                    try:
+                        self._driver._execute_and_insert(
+                            block, _NullStats()
+                        )
+                        break
+                    except MPTNodeMissingException as e:
+                        self._heal_missing_node(peer, e.hash)
+                else:
+                    raise SyncAborted(
+                        f"block {block.header.number} kept failing "
+                        "after heals"
+                    )
+                imported += 1
+                self.imported += 1
         if imported:
             self.log(
                 f"imported {imported} blocks, best now "
@@ -320,6 +339,56 @@ class RegularSyncService:
                 raise SyncAborted("regular sync timed out")
             if self.sync_once() == 0:
                 time.sleep(poll)
+
+    # ------------------------------------------------------ propagation
+
+    def install_new_block_handler(self) -> None:
+        """Import peer-pushed NewBlock messages (the push path;
+        handleNewBlockMsgs role). Pushed blocks that don't attach to our
+        tip just wait for the next pull round to resolve the branch."""
+        self.manager.handlers[ETH_OFFSET + NEW_BLOCK] = self._on_new_block
+        for peer in self.manager.peers:
+            peer.handlers[ETH_OFFSET + NEW_BLOCK] = self._on_new_block
+
+    def _on_new_block(self, body) -> None:
+        # runs on the pushing peer's reader thread: every chain check
+        # AND the import must hold the lock the pull loop holds
+        try:
+            block, _td = decode_new_block(body)
+        except Exception:
+            return None
+        with self._import_lock:
+            our_best = self.blockchain.best_block_number
+            if block.header.number != our_best + 1:
+                return None  # ahead/behind: the pull loop catches up
+            if block.header.parent_hash != (
+                self.blockchain.get_hash_by_number(our_best)
+            ):
+                return None  # side branch: the pull loop's TD rule decides
+            try:
+                self._driver._execute_and_insert(block, _NullStats())
+                self.imported += 1
+                self.log(f"imported pushed block #{block.header.number}")
+            except Exception as e:  # invalid push: pull loop decides
+                self.log(f"pushed block rejected: {e}")
+        return None
+
+
+def broadcast_new_block(manager: PeerManager, block: Block, td: int) -> int:
+    """Push a freshly sealed/imported block to every live peer
+    (BroadcastNewBlocks role; miner + import tail call this). Returns
+    the number of peers reached."""
+    payload = encode_new_block(block, td)
+    sent = 0
+    for peer in list(manager.peers):
+        if not peer.alive:
+            continue
+        try:
+            peer.send(ETH_OFFSET + NEW_BLOCK, payload)
+            sent += 1
+        except Exception:
+            pass
+    return sent
 
 
 class _NullStats:

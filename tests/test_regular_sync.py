@@ -12,6 +12,7 @@ reorg), :448-479 (best peer); HostService.scala (the serving side).
 
 import dataclasses
 import threading
+import time
 
 import pytest
 
@@ -225,3 +226,82 @@ class TestRegularSync:
         assert syncer_bc.best_block_number == 12
         assert sync.healed_nodes >= 1
         assert ns.get(root) is not None  # healed back into the store
+
+
+class TestNewBlockPropagation:
+    def test_pushed_block_imports_without_pull(self, loopback):
+        """The push path (BroadcastNewBlocks role): a sealed block
+        broadcast over NewBlock imports directly on the receiving node;
+        no pull round involved."""
+        from khipu_tpu.sync.regular_sync import broadcast_new_block
+
+        chain = build_chain(6)
+        server_box = _NodeBox(make_serving_node(chain[:5]))
+        syncer_bc = Blockchain(Storages(), CFG)
+        syncer_bc.load_genesis(GenesisSpec(alloc=ALLOC))
+        client_box = _NodeBox(syncer_bc)
+        server, client, peer = loopback(server_box, client_box)
+
+        sync = RegularSyncService(syncer_bc, CFG, client, batch_size=5)
+        sync.install_new_block_handler()
+        sync.run(until=lambda: syncer_bc.best_block_number >= 5,
+                 max_seconds=30)
+
+        # the SERVER pushes block 6 to its peers (miner-broadcast role);
+        # its inbound peer is the client's connection
+        td = (server_box.bc.get_total_difficulty(5) or 0) + chain[5].header.difficulty
+        sent = broadcast_new_block(server, chain[5], td)
+        assert sent == 1
+        deadline = time.time() + 10
+        while syncer_bc.best_block_number < 6 and time.time() < deadline:
+            time.sleep(0.05)
+        assert syncer_bc.best_block_number == 6
+        assert syncer_bc.get_hash_by_number(6) == chain[5].hash
+        assert sync.imported == 6  # 5 pulled + 1 pushed
+
+
+class TestShorterPeerChains:
+    def test_stale_higher_td_shorter_peer_does_not_wedge(self, loopback):
+        """A peer whose advertised TD is stale-high while its chain is
+        SHORTER than ours: the forward fetch is empty, the downward
+        probe finds its (prefix-identical) headers, and the round ends
+        cleanly — no wedge, no bogus reorg, no blacklist."""
+        chain = build_chain(30)
+        # server knows only the first 20 blocks of OUR chain...
+        server_box = _NodeBox(make_serving_node(chain[:20]))
+
+        # ...but lies that it has more TD than anyone
+        def lying_status():
+            real = status_factory(server_box)()
+            return dataclasses.replace(
+                real, total_difficulty=real.total_difficulty * 100
+            )
+
+        syncer_bc = Blockchain(Storages(), CFG)
+        syncer_bc.load_genesis(GenesisSpec(alloc=ALLOC))
+        server = PeerManager(PRIV_A, "khipu-tpu/liar", lying_status)
+        _SwitchingHost(server_box).install(server)
+        port = server.listen()
+        client = PeerManager(
+            PRIV_B, "khipu-tpu/client", status_factory(_NodeBox(syncer_bc))
+        )
+        client.connect("127.0.0.1", port, privkey_to_pubkey(PRIV_A))
+        try:
+            sync = RegularSyncService(syncer_bc, CFG, client, batch_size=7)
+            # catch up to the peer's 20 blocks first
+            sync.run(until=lambda: syncer_bc.best_block_number >= 20,
+                     max_seconds=30)
+            # import the rest of OUR chain locally (we are now longer)
+            ReplayDriver(syncer_bc, CFG).replay(chain[20:])
+            assert syncer_bc.best_block_number == 30
+            # rounds against the stale-TD shorter peer terminate with 0
+            for _ in range(3):
+                assert sync.sync_once() == 0
+            assert syncer_bc.best_block_number == 30
+            assert sync.reorgs == 0
+            assert not client.blacklist.is_blacklisted(
+                privkey_to_pubkey(PRIV_A)
+            )
+        finally:
+            server.stop()
+            client.stop()
