@@ -246,17 +246,22 @@ class RegularSyncService:
         # TD only picks the peer and judges branches.
         try:
             return self._sync_round(peer, our_best, our_td)
-        except Exception as e:  # noqa: BLE001
-            # ANY failure mid-round — wire/protocol (disconnect,
-            # timeout, mismatched body, garbage headers) or an import
-            # error in an adopted branch — demotes the peer and ends
-            # the round; the loop carries on with other peers (the
-            # reference's actor restarts play the same role). A branch
-            # that failed AFTER rollback leaves us at the ancestor;
-            # later rounds sync forward again from there.
+        except PeerError as e:
+            # wire/protocol failure (disconnect, timeout, mismatched
+            # body, garbage headers): demote the peer; the loop carries
+            # on with other peers
             self.log(f"peer failed mid-round: {e}")
             self.manager.blacklist.add(peer.remote_pub, duration=60.0)
             peer.disconnect()
+            return 0
+        except Exception as e:  # noqa: BLE001
+            # a LOCAL failure (storage fault, import error that isn't
+            # attributable to the wire) must not demote an honest peer
+            # — but it must not kill the loop either (the reference's
+            # actor restarts play this role). A branch that failed
+            # AFTER rollback leaves us at the ancestor; later rounds
+            # sync forward again from there.
+            self.log(f"round failed locally: {e}")
             return 0
 
     def _sync_round(self, peer: Peer, our_best: int, our_td: int) -> int:
@@ -265,10 +270,12 @@ class RegularSyncService:
             if peer.status.total_difficulty <= our_td:
                 return 0  # nothing new and no TD claim: at the tip
             # the peer claims higher TD but serves nothing past our tip:
-            # its (heavier) chain is SHORTER than ours. Probe DOWNWARD —
-            # the peer has no header at our height either when its best
-            # is below ours, so descend until it serves a batch
-            # (bounded by the branch-resolving depth)
+            # its (heavier) chain is SHORTER than ours. Probe DOWNWARD
+            # one height at a time — an empty reply only proves the peer
+            # lacks the START height (the serving side bails on the
+            # first missing header), so a coarser step would skip the
+            # heights where its best/branch actually lives. Bounded by
+            # the branch-resolving depth.
             headers = []
             probe = our_best
             floor = max(1, our_best - self.config.sync.block_resolving_depth)
@@ -276,7 +283,7 @@ class RegularSyncService:
                 headers = self._request_headers(
                     peer, probe, self.batch_size, reverse=True
                 )
-                probe -= self.batch_size
+                probe -= 1
             if not headers:
                 return 0
             headers = list(reversed(headers))
@@ -299,13 +306,32 @@ class RegularSyncService:
         blocks = self._fetch_blocks(peer, headers)
         imported = 0
         with self._import_lock:  # excludes the NewBlock push handler
+            # the tip may have MOVED while we fetched (a pushed block
+            # imported by the handler): re-check under the lock
+            cur_best = self.blockchain.best_block_number
             if is_reorg:
                 ancestor_number = headers[0].number - 1
+                anc = self.blockchain.get_header_by_number(ancestor_number)
+                if anc is None or anc.hash != headers[0].parent_hash:
+                    return 0  # chain changed under us; resolve next round
                 self._rollback_to(ancestor_number)
                 self.log(
                     f"reorg: rolled back to #{ancestor_number}, adopting "
                     f"{len(headers)} peer blocks"
                 )
+            else:
+                # drop blocks a concurrent push already covered; if the
+                # remainder no longer attaches, defer to the next round
+                # (the TD rule decides between the competing tips)
+                blocks = [
+                    b for b in blocks if b.header.number > cur_best
+                ]
+                if blocks and blocks[0].header.parent_hash != (
+                    self.blockchain.get_hash_by_number(
+                        blocks[0].header.number - 1
+                    )
+                ):
+                    return 0
             for block in blocks:
                 for attempt in range(3):
                     try:
@@ -351,27 +377,38 @@ class RegularSyncService:
             peer.handlers[ETH_OFFSET + NEW_BLOCK] = self._on_new_block
 
     def _on_new_block(self, body) -> None:
-        # runs on the pushing peer's reader thread: every chain check
-        # AND the import must hold the lock the pull loop holds
+        # Runs on the pushing peer's reader thread: chain checks and the
+        # import must hold the pull loop's lock — but NON-BLOCKING. The
+        # pull loop heals missing nodes via peer.request WHILE holding
+        # the lock; if this handler parked the reader thread waiting on
+        # it, the heal reply could never be read (deadlock-by-timeout).
+        # A dropped push is harmless: the pull loop catches up.
         try:
             block, _td = decode_new_block(body)
         except Exception:
             return None
-        with self._import_lock:
-            our_best = self.blockchain.best_block_number
-            if block.header.number != our_best + 1:
-                return None  # ahead/behind: the pull loop catches up
-            if block.header.parent_hash != (
-                self.blockchain.get_hash_by_number(our_best)
-            ):
-                return None  # side branch: the pull loop's TD rule decides
-            try:
-                self._driver._execute_and_insert(block, _NullStats())
-                self.imported += 1
-                self.log(f"imported pushed block #{block.header.number}")
-            except Exception as e:  # invalid push: pull loop decides
-                self.log(f"pushed block rejected: {e}")
+        if not self._import_lock.acquire(blocking=False):
+            return None
+        try:
+            self._on_new_block_locked(block)
+        finally:
+            self._import_lock.release()
         return None
+
+    def _on_new_block_locked(self, block: Block) -> None:
+        our_best = self.blockchain.best_block_number
+        if block.header.number != our_best + 1:
+            return  # ahead/behind: the pull loop catches up
+        if block.header.parent_hash != (
+            self.blockchain.get_hash_by_number(our_best)
+        ):
+            return  # side branch: the pull loop's TD rule decides
+        try:
+            self._driver._execute_and_insert(block, _NullStats())
+            self.imported += 1
+            self.log(f"imported pushed block #{block.header.number}")
+        except Exception as e:  # invalid push: pull loop decides
+            self.log(f"pushed block rejected: {e}")
 
 
 def broadcast_new_block(manager: PeerManager, block: Block, td: int) -> int:

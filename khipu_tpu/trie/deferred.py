@@ -181,6 +181,30 @@ def _collect_placeholders(structure, out: List[bytes], known) -> None:
         _collect_placeholders(item, out, known)
 
 
+def resolution_inputs(trie: DeferredMPT, subset=None):
+    """(to_resolve, deps, structures) for a deferred session — the
+    placeholder set a resolver must hash and its dependency map. THE
+    single derivation used by finalize (both paths), the sharded
+    resolver, the dryrun and the tests; ``subset`` restricts to given
+    placeholders (finalize's live-only mode) while membership (`known`)
+    always spans every placeholder the session handed out."""
+    staged = {
+        ph: enc for ph, enc in trie._staged.items() if _is_placeholder(ph)
+    }
+    if subset is None:
+        to_resolve = staged
+    else:
+        to_resolve = {ph: staged[ph] for ph in subset}
+    known = frozenset(staged)
+    structures = {ph: rlp_decode(enc) for ph, enc in to_resolve.items()}
+    deps: Dict[bytes, List[bytes]] = {}
+    for ph, struct in structures.items():
+        children: List[bytes] = []
+        _collect_placeholders(struct, children, known)
+        deps[ph] = children
+    return to_resolve, deps, structures
+
+
 def finalize(
     trie: DeferredMPT,
     hasher: Hasher = host_hasher,
@@ -217,26 +241,11 @@ def finalize(
         # superseded by later blocks (net refcount 0 — dead for
         # PERSISTING) yet their resolved hashes are what the per-block
         # root checks compare against. Only live ones persist below.
-        to_resolve: Dict[bytes, bytes] = {
-            ph: enc
-            for ph, enc in trie._staged.items()
-            if _is_placeholder(ph)
-        }
+        to_resolve, deps, structures = resolution_inputs(trie)
     else:
         # plain batch commit: nobody reads dead placeholders — hash
         # only the live set (work scales with live nodes, not churn)
-        to_resolve = live
-    structures = {ph: rlp_decode(enc) for ph, enc in to_resolve.items()}
-    # membership set = EVERY placeholder the session handed out (not
-    # just to_resolve): a reference to a session placeholder outside the
-    # resolve set must still surface as an unresolvable dependency
-    # below, never silently persist as opaque bytes
-    known = frozenset(ph for ph in trie._staged if _is_placeholder(ph))
-    deps: Dict[bytes, List[bytes]] = {}
-    for ph, struct in structures.items():
-        children: List[bytes] = []
-        _collect_placeholders(struct, children, known)
-        deps[ph] = children
+        to_resolve, deps, structures = resolution_inputs(trie, subset=live)
 
     resolved: Dict[bytes, bytes] = {}  # placeholder -> real hash
     final_encoded: Dict[bytes, bytes] = {}  # real hash -> final rlp

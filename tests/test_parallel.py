@@ -89,3 +89,63 @@ def test_snapshot_verify_uneven_batch():
     assert snapshot_verify_sharded(data, keys, mesh) == 0
     keys[10] ^= 0xA5
     assert snapshot_verify_sharded(data, keys, mesh) == 1
+
+
+class TestFusedSharded:
+    def test_sharded_fused_resolve_matches_host_finalize(self):
+        """The mesh form of the one-dispatch window finalize: identical
+        placeholder->hash resolution to the host level loop, with rows
+        sharded over 8 devices and digests all_gathered per round."""
+        import random
+
+        from khipu_tpu.parallel.fused_sharded import fused_resolve_sharded
+        from khipu_tpu.parallel.mesh import device_mesh
+        from khipu_tpu.storage.datasource import MemoryNodeDataSource
+        from khipu_tpu.trie.bulk import host_hasher
+        from khipu_tpu.trie.deferred import (
+            _PLACEHOLDER_PREFIX,
+            DeferredMPT,
+            finalize,
+        )
+        from khipu_tpu.trie.mpt import MerklePatriciaTrie
+
+        rng = random.Random(77)
+        src = MemoryNodeDataSource()
+        base = MerklePatriciaTrie(src)
+        keys = [keccak256(rng.randbytes(8)) for _ in range(200)]
+        for k in keys:
+            base = base.put(k, rng.randbytes(rng.randrange(1, 90)))
+        base = base.persist()
+
+        def session():
+            d = DeferredMPT(
+                base.source,
+                _root_ref=base._root_ref,
+                _logs={h: [c, e] for h, (c, e) in base._logs.items()},
+                _staged=dict(base._staged),
+            )
+            for k in rng.sample(keys, 30):
+                d = d.remove(k)
+            for _ in range(150):
+                d = d.put(keccak256(rng.randbytes(8)), rng.randbytes(40))
+            return d
+
+        state = rng.getstate()
+        loop_trie, loop_map = finalize(
+            session(), host_hasher, return_mapping=True
+        )
+        rng.setstate(state)  # identical session for the sharded run
+        from khipu_tpu.trie.deferred import resolution_inputs
+
+        to_resolve, deps, _ = resolution_inputs(session())
+        mesh = device_mesh(8)
+        sharded_map = fused_resolve_sharded(
+            to_resolve, deps, _PLACEHOLDER_PREFIX, mesh
+        )
+        assert sharded_map == loop_map
+        # and the digests are true content addresses
+        from khipu_tpu.trie.deferred import _substitute_bytes
+
+        for ph, enc in to_resolve.items():
+            final = _substitute_bytes(enc, sharded_map)
+            assert keccak256(final) == sharded_map[ph]
