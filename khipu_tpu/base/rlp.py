@@ -41,17 +41,25 @@ def _encode_length(length: int, offset: int) -> bytes:
     return bytes([offset + 55 + len(length_bytes)]) + length_bytes
 
 
+# single-byte string headers, precomputed (hot: every trie node field)
+_STR_HDR = [bytes([0x80 + n]) for n in range(56)]
+
+
 def rlp_encode(item: RLPItem, _depth: int = 0) -> bytes:
     """Encode bytes / nested lists of bytes."""
-    if isinstance(item, (bytes, bytearray)):
-        item = bytes(item)
-        if len(item) == 1 and item[0] < 0x80:
+    if type(item) is bytes:  # fast path: the overwhelmingly common case
+        n = len(item)
+        if n == 1 and item[0] < 0x80:
             return item
-        return _encode_length(len(item), 0x80) + item
+        if n < 56:
+            return _STR_HDR[n] + item
+        return _encode_length(n, 0x80) + item
+    if isinstance(item, bytearray):
+        return rlp_encode(bytes(item), _depth)
     if isinstance(item, (list, tuple)):
         if _depth >= MAX_DEPTH:
             raise RLPError("RLP nesting exceeds MAX_DEPTH")
-        payload = b"".join(rlp_encode(sub, _depth + 1) for sub in item)
+        payload = b"".join([rlp_encode(sub, _depth + 1) for sub in item])
         return _encode_length(len(payload), 0xC0) + payload
     raise RLPError(f"cannot RLP-encode {type(item)!r}")
 

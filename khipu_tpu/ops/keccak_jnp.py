@@ -139,6 +139,22 @@ def absorb(blocks: jax.Array, nblocks: int) -> jax.Array:
     return jnp.stack(out)
 
 
+def hash_padded_u8(padded_u8, nblocks: int):
+    """Traceable batch hash of already multi-rate-padded byte rows:
+    u8[N, nblocks*RATE] -> u8[N, 32]. THE shared jnp formulation for
+    every fixpoint/sharded consumer (trie/fused.py,
+    parallel/fused_sharded.py) — one place owns the bitcast/absorb
+    packing."""
+    n = padded_u8.shape[0]
+    nwords = nblocks * 2 * LANES_PER_BLOCK
+    w = jax.lax.bitcast_convert_type(
+        padded_u8.reshape(n, nwords, 4), jnp.uint32
+    )
+    blocks = w.reshape(n, nblocks, 2 * LANES_PER_BLOCK).transpose(1, 2, 0)
+    d = absorb(blocks, nblocks)  # [8, N]
+    return jax.lax.bitcast_convert_type(d.T, jnp.uint8).reshape(n, 32)
+
+
 def pad_to_blocks(messages: Sequence[bytes], nblocks: int) -> np.ndarray:
     """Host-side multi-rate padding + u32-lane packing.
 

@@ -51,19 +51,9 @@ def _build_fused_sharded(sig: Tuple[Tuple[int, int, int], ...],
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from khipu_tpu.ops.keccak_jnp import absorb
+    from khipu_tpu.ops.keccak_jnp import hash_padded_u8 as _hash
 
     k = len(sig)
-
-    def _hash(padded_u8, nb):  # u8[rpd, nb*RATE] -> u8[rpd, 32]
-        n = padded_u8.shape[0]
-        nwords = nb * 34
-        w = jax.lax.bitcast_convert_type(
-            padded_u8.reshape(n, nwords, 4), jnp.uint32
-        )
-        blocks = w.reshape(n, nb, 34).transpose(1, 2, 0)
-        d = absorb(blocks, nb)  # [8, n]
-        return jax.lax.bitcast_convert_type(d.T, jnp.uint8).reshape(n, 32)
 
     def shard_body(*args):
         # shards keep the (now size-1) leading device axis: drop it
@@ -129,8 +119,9 @@ def fused_resolve_sharded(
     # local tail row on EVERY device under round-robin assignment
     rpd: Dict[int, int] = {}
     for nb in class_list:
+        # _pow2 with floor 16*n_dev returns 16*n_dev*2^k — always a
+        # multiple of n_dev, so the per-device split below is exact
         total = _pow2(len(classes[nb]) + n_dev, floor=16 * n_dev)
-        total = ((total + n_dev - 1) // n_dev) * n_dev  # non-pow2 meshes
         rpd[nb] = total // n_dev
 
     # global digest position in the gathered table:

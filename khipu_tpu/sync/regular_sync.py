@@ -91,7 +91,10 @@ class RegularSyncService:
             ETH_OFFSET + BLOCK_HEADERS,
             timeout=self.timeout,
         )
-        return decode_headers(body)
+        try:
+            return decode_headers(body)
+        except Exception as e:  # malformed reply IS the peer's fault
+            raise PeerError(f"undecodable headers: {e}")
 
     def _request_bodies(
         self, peer: Peer, hashes: List[bytes]
@@ -102,7 +105,10 @@ class RegularSyncService:
             ETH_OFFSET + BLOCK_BODIES,
             timeout=self.timeout,
         )
-        return decode_bodies(body)
+        try:
+            return decode_bodies(body)
+        except Exception as e:  # malformed reply IS the peer's fault
+            raise PeerError(f"undecodable bodies: {e}")
 
     def _fetch_blocks(
         self, peer: Peer, headers: List[BlockHeader]

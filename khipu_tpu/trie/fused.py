@@ -97,23 +97,10 @@ def _build_fused(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
     import jax.numpy as jnp
 
     if use_jnp:
-        from khipu_tpu.ops.keccak_jnp import absorb
+        from khipu_tpu.ops.keccak_jnp import hash_padded_u8
 
         def _mk_runner(nb):
-            nwords = nb * 34
-
-            def go(padded_u8):  # u8[N, nb*RATE] -> u8[N, 32]
-                n = padded_u8.shape[0]
-                w = jax.lax.bitcast_convert_type(
-                    padded_u8.reshape(n, nwords, 4), jnp.uint32
-                )
-                blocks = w.reshape(n, nb, 34).transpose(1, 2, 0)
-                d = absorb(blocks, nb)  # [8, N]
-                return jax.lax.bitcast_convert_type(
-                    d.T, jnp.uint8
-                ).reshape(n, 32)
-
-            return go
+            return lambda padded_u8: hash_padded_u8(padded_u8, nb)
 
         runners = [_mk_runner(nb) for nb, _, _ in sig]
     else:
