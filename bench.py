@@ -372,6 +372,70 @@ def bench_snapshot_verify(N=1 << 20, L=576):
     )
 
 
+def bench_keccak_wordmajor_resident(N=1 << 20, L=576, ROUNDS=8):
+    """Secondary #2 datapoint: same workload with the node words already
+    WORD-MAJOR tiled at rest (the layout the store's device mirror can
+    keep) — i.e. the full path minus the batch->word-major HBM
+    transpose, which docs/roofline.md identifies as the remaining gap to
+    the kernel bound. Clearly labeled as layout-resident, NOT a
+    replacement for the primary (which pays the neutral batch-major
+    ingestion)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from khipu_tpu.base.crypto.keccak import keccak256
+    from khipu_tpu.ops.keccak_jnp import RATE
+    from khipu_tpu.ops.keccak_pallas import TILE, _build
+
+    assert N % TILE == 0, "whole tiles only (the metric divides by N)"
+    nblocks = L // RATE + 1
+    nwords = L // 4
+    run = _build(nblocks, False, nwords_in=nwords)
+    tiles = N // TILE
+    base = jax.random.bits(
+        jax.random.PRNGKey(7), (tiles, nwords, 8, 128), jnp.uint32
+    )
+
+    @jax.jit
+    def step(tiled, salt0):
+        def body(i, carry):
+            acc, salt = carry
+            return acc ^ run(tiled ^ salt), salt + jnp.uint32(1)
+        acc, _ = jax.lax.fori_loop(
+            0, ROUNDS, body,
+            (jnp.zeros((tiles, 8, 8, 128), jnp.uint32), salt0),
+        )
+        return acc
+
+    # correctness gate against the scalar oracle (one message)
+    d = run(base)
+    row = np.asarray(
+        jax.device_get(base[0, :, 0, 0])
+    ).astype("<u4").tobytes()
+    dig = np.asarray(
+        jax.device_get(d[0, :, 0, 0])
+    ).astype("<u4").tobytes()
+    assert dig == keccak256(row), "word-major kernel mismatch"
+
+    np.asarray(jax.device_get(step(base, jnp.uint32(0))[0, 0, 0, :1]))
+    times = []
+    for i in range(1, 6):
+        t0 = time.perf_counter()
+        np.asarray(
+            jax.device_get(step(base, jnp.uint32(i * ROUNDS))[0, 0, 0, :1])
+        )
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[len(times) // 2]
+    emit(
+        "keccak256_576B_wordmajor_resident_hashes_per_sec_per_chip",
+        round(ROUNDS * N / dt),
+        "hashes/s/chip",
+        note="layout-resident variant: store's device mirror keeps "
+             "word-major tiles, no ingestion transpose (see roofline)",
+    )
+
+
 def bench_keccak_primary():
     """Config #2 (primary): batched Keccak on one chip, steady state.
 
@@ -446,6 +510,7 @@ def main() -> None:
     bench_replay_contended()
     bench_bulk_build()
     bench_snapshot_verify()
+    bench_keccak_wordmajor_resident()
     bench_keccak_primary()  # primary metric: keep LAST
 
 
