@@ -152,6 +152,89 @@ class EthService:
         )
         return qty(len(block.body.ommers)) if block else None
 
+    def _number_of_hash(self, block_hash: str) -> Optional[int]:
+        return self.blockchain.storages.block_numbers.number_of(
+            parse_data(block_hash)
+        )
+
+    def eth_getBlockTransactionCountByHash(self, block_hash: str):
+        n = self._number_of_hash(block_hash)
+        return (
+            None if n is None
+            else self.eth_getBlockTransactionCountByNumber(n)
+        )
+
+    def eth_getUncleCountByBlockHash(self, block_hash: str):
+        n = self._number_of_hash(block_hash)
+        return None if n is None else self.eth_getUncleCountByBlockNumber(n)
+
+    def eth_getTransactionByBlockNumberAndIndex(self, tag, index):
+        n = self._resolve_block(tag)
+        i = index if isinstance(index, int) else int(str(index), 16)
+        block = self.blockchain.get_block_by_number(n)
+        if block is None or i >= len(block.body.transactions):
+            return None
+        return self._tx_json(block.body.transactions[i], block, i)
+
+    def eth_getTransactionByBlockHashAndIndex(self, block_hash: str, index):
+        n = self._number_of_hash(block_hash)
+        if n is None:
+            return None
+        return self.eth_getTransactionByBlockNumberAndIndex(n, index)
+
+    def _uncle_json(self, block, i: int):
+        if block is None or i >= len(block.body.ommers):
+            return None
+        # EthService.getUncleByBlockHashAndIndex: a header-only block
+        # JSON (uncles carry no body)
+        u = block.body.ommers[i]
+        return {
+            "number": qty(u.number),
+            "hash": data(u.hash),
+            "parentHash": data(u.parent_hash),
+            "miner": data(u.beneficiary),
+            "stateRoot": data(u.state_root),
+            "difficulty": qty(u.difficulty),
+            "gasLimit": qty(u.gas_limit),
+            "gasUsed": qty(u.gas_used),
+            "timestamp": qty(u.unix_timestamp),
+            "extraData": data(u.extra_data),
+            "uncles": [],
+            "transactions": [],
+        }
+
+    def eth_getUncleByBlockNumberAndIndex(self, tag, index):
+        i = index if isinstance(index, int) else int(str(index), 16)
+        block = self.blockchain.get_block_by_number(self._resolve_block(tag))
+        return self._uncle_json(block, i)
+
+    def eth_getUncleByBlockHashAndIndex(self, block_hash: str, index):
+        n = self._number_of_hash(block_hash)
+        if n is None:
+            return None
+        return self.eth_getUncleByBlockNumberAndIndex(n, index)
+
+    def net_listening(self) -> bool:
+        return True
+
+    def net_peerCount(self) -> str:
+        manager = getattr(self, "peer_manager", None)
+        alive = (
+            sum(1 for p in manager.peers if p.alive) if manager else 0
+        )
+        return qty(alive)
+
+    def eth_accounts(self):
+        # keystore-backed accounts surface through personal_listAccounts;
+        # the bare node exposes none (reference returns the same)
+        return []
+
+    def eth_mining(self) -> bool:
+        return getattr(self, "miner", None) is not None
+
+    def eth_hashrate(self) -> str:
+        return qty(0)  # external miners report via submitHashrate (absent)
+
     def eth_getBlockByNumber(self, tag, full_txs: bool = False):
         n = self._resolve_block(tag)
         block = self.blockchain.get_block_by_number(n)

@@ -227,3 +227,63 @@ class TestKeyStore:
             ks.unlock(addr, "nope")
         with pytest.raises(KeyStoreError):
             ks.unlock(b"\x01" * 20, "pw")
+
+
+class TestByHashAndIndexMethods:
+    """The hash-keyed / index-keyed lookups and node-info methods added
+    for parity with the reference's full EthService surface."""
+
+    def test_counts_by_hash_match_by_number(self, chain, service):
+        h2 = service.eth_getBlockByNumber(2)["hash"]
+        assert (
+            service.eth_getBlockTransactionCountByHash(h2)
+            == service.eth_getBlockTransactionCountByNumber(2)
+        )
+        assert (
+            service.eth_getUncleCountByBlockHash(h2)
+            == service.eth_getUncleCountByBlockNumber(2)
+        )
+        missing = "0x" + "ab" * 32
+        assert service.eth_getBlockTransactionCountByHash(missing) is None
+        assert service.eth_getUncleCountByBlockHash(missing) is None
+
+    def test_tx_by_block_and_index(self, chain, service):
+        tx = service.eth_getTransactionByBlockNumberAndIndex(2, 0)
+        assert tx is not None
+        by_hash = service.eth_getTransactionByHash(tx["hash"])
+        assert by_hash == tx
+        h2 = service.eth_getBlockByNumber(2)["hash"]
+        assert service.eth_getTransactionByBlockHashAndIndex(h2, "0x0") == tx
+        assert service.eth_getTransactionByBlockNumberAndIndex(2, 7) is None
+
+    def test_uncle_by_index_empty_blocks(self, service):
+        assert service.eth_getUncleByBlockNumberAndIndex(2, 0) is None
+
+    def test_uncle_by_index_real_ommer(self):
+        import dataclasses as dc
+
+        builder = ChainBuilder(
+            Blockchain(Storages(), CFG), CFG,
+            GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}),
+        )
+        b1 = builder.add_block([], coinbase=b"\xaa" * 20)
+        ommer = dc.replace(
+            b1.header, beneficiary=ADDRS[2], extra_data=b"uncle"
+        )
+        builder.add_block([], coinbase=b"\xaa" * 20, ommers=(ommer,))
+        svc = EthService(builder.blockchain, CFG)
+        u = svc.eth_getUncleByBlockNumberAndIndex(2, 0)
+        assert u is not None
+        assert u["hash"] == "0x" + ommer.hash.hex()
+        assert u["miner"] == "0x" + ADDRS[2].hex()
+        assert u["transactions"] == []
+        h2 = svc.eth_getBlockByNumber(2)["hash"]
+        assert svc.eth_getUncleByBlockHashAndIndex(h2, "0x0") == u
+        assert svc.eth_getUncleCountByBlockHash(h2) == "0x1"
+
+    def test_node_info_methods(self, service):
+        assert service.net_listening() is True
+        assert service.net_peerCount() == "0x0"
+        assert service.eth_accounts() == []
+        assert service.eth_mining() is False
+        assert service.eth_hashrate() == "0x0"
