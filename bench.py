@@ -94,16 +94,16 @@ def _replay_fixture(parallel, window, alloc, build_blocks, device_commit):
     )
     blocks = [_Block.decode(b.encode()) for b in build_blocks(builder)]
     if device_commit:
-        # NB: keep n_blocks a MULTIPLE of window — a trailing partial
-        # window would land in a different compiled shape bucket than
-        # the one this warm-up compiles, re-introducing cold-compile
-        # skew into the timed region
+        # warm-up replays the WHOLE chain: later windows can land in
+        # different compiled shape buckets than the first (the trie
+        # grows), and a cold XLA compile inside the timed region would
+        # swamp the steady-state number the bench reports
         warm = Blockchain(Storages(), cfg)
         warm.load_genesis(GenesisSpec(alloc=alloc))
         # fresh decodes: the warm-up must not pre-populate the cached
         # senders on the block objects the timed replay will measure
         ReplayDriver(warm, cfg, device_commit=True).replay(
-            [_Block.decode(b.encode()) for b in blocks[:window]]
+            [_Block.decode(b.encode()) for b in blocks]
         )
     target = Blockchain(Storages(), cfg)
     target.load_genesis(GenesisSpec(alloc=alloc))
@@ -165,6 +165,7 @@ def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
         window=window,
         n_blocks=n_blocks,
         txs_per_block=txs_per_block,
+        phases=stats.phase_line(),
         **({"note": note} if note else {}),
     )
 
@@ -504,7 +505,7 @@ def main() -> None:
         ),
     )
     bench_replay(
-        8, 50, "replay_parallel_commit_fixture_blocks_per_sec",
+        32, 50, "replay_parallel_commit_fixture_blocks_per_sec",
         parallel=True, window=8,
     )
     bench_replay_contended()

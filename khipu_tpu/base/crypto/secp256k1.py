@@ -58,6 +58,11 @@ def _native():
                     ctypes.c_char_p,
                 ]
                 lib.khipu_ec_mul_add.restype = ctypes.c_int
+                if hasattr(lib, "khipu_ecdsa_recover_batch"):
+                    lib.khipu_ecdsa_recover_batch.argtypes = [
+                        ctypes.c_int
+                    ] + [ctypes.c_char_p] * 5
+                    lib.khipu_ecdsa_recover_batch.restype = ctypes.c_int
                 _native_lib = lib
         except Exception:
             _native_lib = None
@@ -303,6 +308,56 @@ def ecdsa_recover(msg_hash: bytes, recid: int, r: int, s: int) -> bytes:
     if Q is None:
         raise SignatureError("recovered point at infinity")
     return Q[0].to_bytes(32, "big") + Q[1].to_bytes(32, "big")
+
+
+def ecdsa_recover_batch(items) -> list:
+    """Recover many signatures in ONE native call (the tx-sender hot
+    loop: one ctypes crossing per block, Strauss-Shamir wNAF ladders,
+    one Montgomery batch inversion across the whole batch). ``items``
+    is a list of (msg_hash, recid, r, s); returns a list of 64-byte
+    public keys, None where the signature is invalid. Falls back to
+    per-item :func:`ecdsa_recover` without the native library."""
+    lib = _native()
+    if lib is None or not hasattr(lib, "khipu_ecdsa_recover_batch"):
+        out = []
+        for msg_hash, recid, r, s in items:
+            try:
+                out.append(ecdsa_recover(msg_hash, recid, r, s))
+            except SignatureError:
+                out.append(None)
+        return out
+    import ctypes
+
+    n = len(items)
+    if n == 0:
+        return []
+    msg = bytearray(32 * n)
+    rec = bytearray(n)
+    rs = bytearray(64 * n)
+    for i, (msg_hash, recid, r, s) in enumerate(items):
+        if not (0 <= recid <= 3 and 0 < r < N and 0 < s < N):
+            rec[i] = 255  # native rejects out-of-range recids -> None
+            continue
+        msg[32 * i : 32 * i + 32] = msg_hash
+        rec[i] = recid
+        rs[64 * i : 64 * i + 32] = r.to_bytes(32, "big")
+        rs[64 * i + 32 : 64 * i + 64] = s.to_bytes(32, "big")
+    out_buf = ctypes.create_string_buffer(64 * n)
+    ok_buf = ctypes.create_string_buffer(n)
+    lib.khipu_ecdsa_recover_batch(
+        ctypes.c_int(n),
+        bytes(msg),
+        bytes(rec),
+        bytes(rs),
+        out_buf,
+        ok_buf,
+    )
+    results = []
+    raw = out_buf.raw
+    oks = ok_buf.raw
+    for i in range(n):
+        results.append(raw[64 * i : 64 * i + 64] if oks[i] else None)
+    return results
 
 
 def ecdsa_verify(msg_hash: bytes, pubkey_xy: bytes, r: int, s: int) -> bool:

@@ -16,6 +16,7 @@ from khipu_tpu.base.crypto.keccak import keccak256
 from khipu_tpu.base.crypto.secp256k1 import (
     SignatureError,
     ecdsa_recover,
+    ecdsa_recover_batch,
     ecdsa_sign,
     pubkey_to_address,
 )
@@ -87,13 +88,8 @@ class SignedTransaction:
     def sender(self) -> Optional[bytes]:
         """Recovered 20-byte sender, or None when the signature is
         invalid (SignedTransaction.scala:143)."""
-        if self.v in (27, 28):
-            recid = self.v - 27
-            chain_id = None
-        elif self.v >= 35:
-            recid = (self.v - 35) % 2
-            chain_id = (self.v - 35) // 2
-        else:
+        recid, chain_id = self._recid_chain_id()
+        if recid is None:
             return None
         try:
             pub = ecdsa_recover(
@@ -102,6 +98,13 @@ class SignedTransaction:
         except SignatureError:
             return None
         return pubkey_to_address(pub)
+
+    def _recid_chain_id(self):
+        if self.v in (27, 28):
+            return self.v - 27, None
+        if self.v >= 35:
+            return (self.v - 35) % 2, (self.v - 35) // 2
+        return None, None
 
     @staticmethod
     def decode(data: bytes) -> "SignedTransaction":
@@ -131,6 +134,33 @@ def sign_transaction(
     recid, r, s = ecdsa_sign(tx.signing_hash(chain_id), priv)
     v = (27 + recid) if chain_id is None else (35 + 2 * chain_id + recid)
     return SignedTransaction(tx, v, r, s)
+
+
+def recover_senders(stxs) -> None:
+    """Batch-recover and cache ``sender`` for every transaction of a
+    block in ONE native call (replay's per-block sender phase;
+    Ledger.scala's parallel recovery inside the tx pool). Transactions
+    whose sender is already cached are skipped; invalid signatures
+    cache None — identical semantics to the per-tx property."""
+    todo = []
+    metas = []
+    for stx in stxs:
+        if "sender" in stx.__dict__:
+            continue
+        recid, chain_id = stx._recid_chain_id()
+        if recid is None:
+            stx.__dict__["sender"] = None
+            continue
+        todo.append(stx)
+        metas.append(
+            (stx.tx.signing_hash(chain_id), recid, stx.r, stx.s)
+        )
+    if not todo:
+        return
+    for stx, pub in zip(todo, ecdsa_recover_batch(metas)):
+        stx.__dict__["sender"] = (
+            pubkey_to_address(pub) if pub is not None else None
+        )
 
 
 def contract_address(sender: bytes, nonce: int) -> bytes:

@@ -411,15 +411,29 @@ def _execute_parallel(
 ):
     """Optimistic parallel execution + serial merge (P1,
     Ledger.scala:337-461)."""
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        futures = [
-            pool.submit(
-                _run_one, config, lambda: make_world(parent_root),
-                block_env, txs[i], senders[i], i, header.gas_limit,
+    import os
+
+    if (os.cpu_count() or 1) > 1:
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            futures = [
+                pool.submit(
+                    _run_one, config, lambda: make_world(parent_root),
+                    block_env, txs[i], senders[i], i, header.gas_limit,
+                )
+                for i in range(len(txs))
+            ]
+            outcomes = [f.result() for f in futures]
+    else:
+        # one core: threads only add scheduling overhead — run the
+        # SAME optimistic attempts inline (identical snapshot + merge
+        # algebra; parallel_count/conflict semantics unchanged)
+        outcomes = [
+            _run_one(
+                config, lambda: make_world(parent_root), block_env,
+                txs[i], senders[i], i, header.gas_limit,
             )
             for i in range(len(txs))
         ]
-        outcomes = [f.result() for f in futures]
 
     merged = make_world(parent_root)
     receipts: List[Receipt] = []

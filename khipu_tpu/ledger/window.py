@@ -26,25 +26,41 @@ from khipu_tpu.domain.account import address_key
 from khipu_tpu.domain.block_header import BlockHeader
 from khipu_tpu.ledger.world import BlockWorldState
 from khipu_tpu.trie.bulk import Hasher, host_hasher
-from khipu_tpu.trie.deferred import DeferredMPT, finalize as finalize_deferred
+from khipu_tpu.trie.deferred import (
+    DeferredMPT,
+    _is_placeholder,
+    _make_placeholder,
+    _substitute_bytes,
+    _PLACEHOLDER_PREFIX,
+)
 from khipu_tpu.trie.mpt import EMPTY_TRIE_HASH
 
 
 class _StagedReadThrough:
     """Node source that serves the window's staged (unresolved) nodes
     first, then the underlying storage — how a block reads state
-    committed by earlier blocks of the same open window."""
+    committed by earlier blocks of the same open window.
 
-    __slots__ = ("inner", "staged")
+    ``resolved`` maps pruned placeholders to their real hashes: once a
+    window is collected its staged encodings are dropped (the nodes are
+    persisted), but retained trie structure still holds placeholder
+    refs into it — those reads indirect through the mapping to the
+    store instead of keeping every encoding alive (memory bound)."""
 
-    def __init__(self, inner, staged: Dict[bytes, bytes]):
+    __slots__ = ("inner", "staged", "resolved")
+
+    def __init__(self, inner, staged: Dict[bytes, bytes], resolved=None):
         self.inner = inner
         self.staged = staged
+        self.resolved = resolved if resolved is not None else {}
 
     def get(self, key: bytes) -> Optional[bytes]:
         v = self.staged.get(key)
         if v is not None:
             return v
+        real = self.resolved.get(key)
+        if real is not None:
+            return self.inner.get(real)
         return self.inner.get(key)
 
 
@@ -76,15 +92,28 @@ class WindowCommitter:
         # only storage placeholders need tagging: finalize routes nodes
         # account-side by default and storage-side on membership here
         self._storage_phs: Set[bytes] = set()
+        # multi-window session state: placeholders of already-collected
+        # windows resolve through this map (seal substitutes them into
+        # later windows' encodings before packing)
+        self._resolved_global: Dict[bytes, bytes] = {}
+        self._window_start = 0  # counter value at the last seal
 
         self._storage_source = _StagedReadThrough(
-            storages.storage_node_storage, self._staged
+            storages.storage_node_storage, self._staged,
+            self._resolved_global,
         )
         self._evmcode_source = _StagedReadThrough(
             storages.evmcode_storage, {}
         )
+        # code hashes staged since the last seal (collect persists ONLY
+        # the sealed window's codes — later windows' stay staged until
+        # their own roots pass)
+        self._window_codes: List[bytes] = []
         self.account_trie = DeferredMPT(
-            _StagedReadThrough(storages.account_node_storage, self._staged),
+            _StagedReadThrough(
+                storages.account_node_storage, self._staged,
+                self._resolved_global,
+            ),
             root_hash=parent_root,
             _logs=self._logs,
             _staged=self._staged,
@@ -126,7 +155,10 @@ class WindowCommitter:
         self.account_trie = trie
         for code in world.codes.values():
             if code:
-                self._evmcode_source.staged[keccak256(code)] = code
+                h = keccak256(code)
+                if h not in self._evmcode_source.staged:
+                    self._window_codes.append(h)
+                self._evmcode_source.staged[h] = code
         self._pending_blocks.append(
             (header, trie.force_hashed_root())
         )
@@ -148,38 +180,166 @@ class WindowCommitter:
             ref_sink=self._storage_phs,
         )
 
-    # ---------------------------------------------------------- finalize
+    # ------------------------------------------------------ seal/collect
 
-    def finalize(self) -> List[Tuple[BlockHeader, bytes]]:
-        """Resolve the whole window's placeholder DAG (batched, level-
-        synchronous), CHECK every block root against its header, persist
-        all nodes + codes. Returns [(header, real_root)]."""
-        resolved_trie, mapping = finalize_deferred(
-            self.account_trie, self.hasher, return_mapping=True,
-            fused=self.fused,
-        )
+    def seal(self) -> "WindowJob":
+        """Close the current window: pack its placeholder DAG and
+        DISPATCH the fused fixpoint program (async — the device hashes
+        while the caller executes the next window's transactions), or
+        resolve synchronously on the host-hasher path. The session
+        continues: later blocks keep reading the sealed window's staged
+        nodes and committing into the same namespace.
+
+        Requires every previous window to be collected (their resolved
+        hashes are substituted into this window's encodings, so the
+        packed DAG only spans this window's own placeholders)."""
+        start, end = self._window_start, self._counter[0]
+        self._window_start = end
+        pending, self._pending_blocks = self._pending_blocks, []
+        # fresh log namespace for the next window; the retained account
+        # trie must adopt it (its children share _logs by reference)
+        live = {
+            ph: rec[0]
+            for ph, rec in self._logs.items()
+            if _is_placeholder(ph) and rec[0] > 0
+        }
+        self._logs = {}
+        self.account_trie._logs = self._logs
+
+        resolved_global = self._resolved_global
+        to_resolve: Dict[bytes, bytes] = {}
+        deps: Dict[bytes, List[bytes]] = {}
+        for idx in range(start, end):
+            ph = _make_placeholder(idx)
+            enc = self._staged.get(ph)
+            if enc is None:
+                continue  # e.g. another session's counter range
+            sub = _substitute_bytes(enc, resolved_global)
+            to_resolve[ph] = sub
+        for ph, enc in to_resolve.items():
+            children: List[bytes] = []
+            pos = enc.find(_PLACEHOLDER_PREFIX)
+            while pos >= 0:
+                child = enc[pos : pos + 32]
+                if child in to_resolve:
+                    children.append(child)
+                elif child in self._staged:
+                    # a session placeholder that is neither this
+                    # window's nor resolved: the previous window was
+                    # never collected — hashing would bake placeholder
+                    # bytes into the node
+                    raise AssertionError(
+                        "seal() before collect() of the previous window"
+                    )
+                pos = enc.find(_PLACEHOLDER_PREFIX, pos + 32)
+            deps[ph] = children
+
+        job = WindowJob(self, pending, to_resolve, live)
+        job.codes, self._window_codes = self._window_codes, []
+        if self.fused and to_resolve:
+            try:
+                import jax
+
+                from khipu_tpu.trie.fused import (
+                    FusedUnsupported,
+                    fused_submit,
+                )
+
+                job.fused_job = fused_submit(
+                    to_resolve, deps, _PLACEHOLDER_PREFIX,
+                    use_jnp=jax.default_backend() != "tpu",
+                )
+                return job
+            except FusedUnsupported:
+                pass
+        # host path: level-synchronous hasher loop, resolved eagerly
+        from khipu_tpu.trie.fused import topo_levels
+
+        mapping: Dict[bytes, bytes] = {}
+        for level in topo_levels(deps):
+            encodings = [
+                _substitute_bytes(to_resolve[ph], mapping) for ph in level
+            ]
+            digests = self.hasher(encodings)
+            mapping.update(zip(level, digests))
+        job.mapping = mapping
+        return job
+
+    def collect(self, job: "WindowJob") -> List[Tuple[BlockHeader, bytes]]:
+        """Wait for a sealed window's digests, CHECK every block root
+        against its header, persist its live nodes + codes, and fold the
+        mapping into the session. Returns [(header, real_root)]."""
+        mapping = job.mapping
+        if mapping is None:
+            mapping = job.fused_job.collect()
+        resolved_global = self._resolved_global
 
         results: List[Tuple[BlockHeader, bytes]] = []
-        for header, root_ref in self._pending_blocks:
-            real = mapping.get(root_ref, root_ref)
+        for header, root_ref in job.pending_blocks:
+            real = mapping.get(root_ref) or resolved_global.get(
+                root_ref, root_ref
+            )
             if real != header.state_root:
                 raise WindowMismatch(header.number, real, header.state_root)
             results.append((header, real))
 
-        # route nodes to their stores by session tag
-        _, upserts = resolved_trie.changes()
+        # persist LIVE nodes only (dead intermediates were hashed for the
+        # root checks but nothing references them), routed by session tag
         account_nodes: Dict[bytes, bytes] = {}
         storage_nodes: Dict[bytes, bytes] = {}
-        for ph, real in mapping.items():
-            enc = upserts.get(real)
-            if enc is None:
-                continue
+        for ph in job.live:
+            real = mapping[ph]
+            enc = _substitute_bytes(job.to_resolve[ph], mapping)
             if ph in self._storage_phs:
                 storage_nodes[real] = enc
             else:
                 account_nodes[real] = enc
         self.storages.account_node_storage.update([], account_nodes)
         self.storages.storage_node_storage.update([], storage_nodes)
-        for code_hash, code in self._evmcode_source.staged.items():
-            self.storages.evmcode_storage.put(code_hash, code)
+        # only THIS window's codes persist (later windows' roots are
+        # still unchecked; their codes stay staged until their collect)
+        staged_codes = self._evmcode_source.staged
+        for code_hash in job.codes:
+            code = staged_codes.pop(code_hash, None)
+            if code is not None:
+                self.storages.evmcode_storage.put(code_hash, code)
+        resolved_global.update(mapping)
+        # prune the collected window's staged encodings: the live nodes
+        # are persisted and retained trie refs read through the
+        # resolved mapping (_StagedReadThrough); dead ones are
+        # unreferenced — keeps session memory ~O(open windows), not
+        # O(replayed chain)
+        staged = self._staged
+        storage_phs = self._storage_phs
+        for ph in job.to_resolve:
+            staged.pop(ph, None)
+            storage_phs.discard(ph)
         return results
+
+    # ---------------------------------------------------------- finalize
+
+    def finalize(self) -> List[Tuple[BlockHeader, bytes]]:
+        """Resolve the whole open window's placeholder DAG, CHECK every
+        block root against its header, persist all nodes + codes.
+        Returns [(header, real_root)]. (seal + collect back to back —
+        the pipelined replay driver calls them separately to overlap the
+        device wait with the next window's host execution.)"""
+        return self.collect(self.seal())
+
+
+class WindowJob:
+    """A sealed window in flight: its packed DAG (placeholder -> pre-
+    substituted encoding), live set, pending block-root checks, and
+    either an async FusedJob (device) or an eager mapping (host)."""
+
+    __slots__ = ("committer", "pending_blocks", "to_resolve", "live",
+                 "fused_job", "mapping", "codes")
+
+    def __init__(self, committer, pending_blocks, to_resolve, live):
+        self.committer = committer
+        self.pending_blocks = pending_blocks
+        self.to_resolve = to_resolve
+        self.live = live
+        self.fused_job = None
+        self.mapping: Optional[Dict[bytes, bytes]] = None
+        self.codes: List[bytes] = []

@@ -19,6 +19,7 @@ from khipu_tpu.config import KhipuConfig
 from khipu_tpu.domain.block import Block
 from khipu_tpu.domain.blockchain import Blockchain
 from khipu_tpu.domain.difficulty import calc_difficulty
+from khipu_tpu.domain.transaction import recover_senders
 from khipu_tpu.ledger.ledger import execute_block
 from khipu_tpu.validators.validators import (
     BlockHeaderValidator,
@@ -35,10 +36,17 @@ class ReplayStats:
     seconds: float = 0.0
     parallel_txs: int = 0
     conflicts: int = 0
+    # per-phase wall-clock split (seconds): senders / validate / execute
+    # / commit / seal / collect / save — the breakdown that names the
+    # next bottleneck instead of guessing it
+    phases: dict = field(default_factory=dict)
 
     @property
     def blocks_per_s(self) -> float:
         return self.blocks / self.seconds if self.seconds else 0.0
+
+    def phase_line(self) -> dict:
+        return {k: round(v, 3) for k, v in self.phases.items()}
 
 
 class ReplayDriver:
@@ -62,6 +70,9 @@ class ReplayDriver:
             ),
         )
         self.validate_headers = validate_headers
+        # windowed-session epoch: blocks between committer resets (see
+        # replay_windowed) — bounds session memory on long replays
+        self.session_epoch_blocks = 512
         # route dirty-node hashing of every block commit through the
         # batched device path (Pallas on TPU); save_block's persisted-
         # root == header.state_root check gates it per block
@@ -87,36 +98,49 @@ class ReplayDriver:
     def replay_windowed(
         self, blocks: Iterable[Block], window_size: int
     ) -> ReplayStats:
-        """Window-batched replay: execute W blocks against one open
-        deferred session, then resolve every trie node of the window in
-        a single level-synchronous device pass and check all W roots
-        (the north-star commit pipeline; ledger/window.py)."""
+        """Window-batched PIPELINED replay: execute W blocks against one
+        open deferred session, seal the window (pack + async device
+        dispatch of the fused fixpoint), then execute the NEXT window's
+        transactions on the host while the device resolves the previous
+        one — the double-buffering that hides the device round-trip
+        behind host execution (SURVEY §7.4-5; the reference overlaps
+        execution with persistence the same way via its actor mailbox,
+        RegularSyncService.scala:381). Root checks happen at collect —
+        one window later than the serial path, with identical failure
+        semantics (nothing of a window persists before its roots pass).
+        """
+        from collections import deque
+
         from khipu_tpu.evm.config import for_block
         from khipu_tpu.ledger.window import WindowCommitter
         from khipu_tpu.trie.bulk import host_hasher
 
         stats = ReplayStats()
+        ph = stats.phases
+        for k in ("senders", "validate", "execute", "commit", "seal",
+                  "collect", "save"):
+            ph[k] = 0.0
         t_start = time.perf_counter()
         hasher = self.hasher or host_hasher
-        pending: List[Block] = []
+        blocks = iter(blocks)
+        try:
+            first = next(blocks)
+        except StopIteration:
+            return stats
 
-        def flush_window():
-            if not pending:
-                return
-            parent = self.blockchain.get_header_by_number(
-                pending[0].number - 1
-            )
-            window_headers = {}
-            window_headers_full = {}
-            window_blocks = {}
+        parent = self.blockchain.get_header_by_number(first.number - 1)
+        window_headers = {}
+        window_headers_full = {}
+        window_blocks = {}
 
-            def block_hash_of(n: int):
-                h = window_headers.get(n)
-                return h if h else self.blockchain.get_hash_by_number(n)
+        def block_hash_of(n: int):
+            h = window_headers.get(n)
+            return h if h else self.blockchain.get_hash_by_number(n)
 
-            committer = WindowCommitter(
+        def make_committer(parent_root: bytes) -> WindowCommitter:
+            return WindowCommitter(
                 self.blockchain.storages,
-                parent.state_root,
+                parent_root,
                 hasher=hasher,
                 account_start_nonce=(
                     self.config.blockchain.account_start_nonce
@@ -127,43 +151,24 @@ class ReplayDriver:
                 # round-trips per window (docs/roofline.md)
                 fused=self.hasher is not None,
             )
-            results = []
-            prev = parent
-            for block in pending:
-                header = block.header
-                if self.validate_headers:
-                    self.header_validator.validate(header, prev)
-                BlockValidator.validate_body(block)
-                OmmersValidator.validate(
-                    self.blockchain, block,
-                    header_lookup=window_headers_full.get,
-                    block_lookup=window_blocks.get,
-                    header_validator=(
-                        self.header_validator
-                        if self.validate_headers else None
-                    ),
-                )
-                config = for_block(header.number, self.config.blockchain)
-                if not config.byzantium:
-                    raise ValueError(
-                        "window commits need Byzantium receipts "
-                        "(pre-Byzantium receipts embed per-tx roots)"
-                    )
-                result = execute_block(
-                    block,
-                    b"",  # the open session IS the parent state
-                    committer.make_world,
-                    self.config,
-                    validate=True,
-                    check_root=False,  # deferred to window finalize
-                )
-                committer.commit_block(result.world, header)
-                window_headers[header.number] = header.hash
-                window_headers_full[header.number] = header
-                window_blocks[header.number] = block
-                results.append((block, result))
-                prev = header
-            committer.finalize()  # raises WindowMismatch on divergence
+
+        committer = make_committer(parent.state_root)
+        in_flight: deque = deque()  # (WindowJob, [(block, result)])
+        # epoch reset: every N blocks the session committer is rebuilt
+        # from the last VALIDATED root, dropping the resolved-
+        # placeholder map and all retained refs — with the per-collect
+        # staged prune this bounds replay memory to O(epoch), not
+        # O(chain) (the reference's analog is its bounded node cache +
+        # persisted store)
+        epoch = self.session_epoch_blocks
+        blocks_since_reset = 0
+
+        def collect_one():
+            job, results = in_flight.popleft()
+            t0 = time.perf_counter()
+            committer.collect(job)  # raises WindowMismatch on divergence
+            ph["collect"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
             for block, result in results:
                 td = (
                     self.blockchain.get_total_difficulty(block.number - 1)
@@ -178,19 +183,96 @@ class ReplayDriver:
                 stats.gas += result.gas_used
                 stats.parallel_txs += result.stats.parallel_count
                 stats.conflicts += result.stats.conflict_count
+            ph["save"] += time.perf_counter() - t0
             if self.log is not None:
                 self.log(
-                    f"Committed window [{pending[0].number}.."
-                    f"{pending[-1].number}] ({len(pending)} blocks) "
+                    f"Committed window [{results[0][0].number}.."
+                    f"{results[-1][0].number}] ({len(results)} blocks) "
                     "in one batched device pass"
                 )
-            pending.clear()
 
-        for block in blocks:
-            pending.append(block)
-            if len(pending) >= window_size:
-                flush_window()
-        flush_window()
+        results_cur: List = []
+        prev = parent
+        import itertools
+
+        for block in itertools.chain((first,), blocks):
+            header = block.header
+            t0 = time.perf_counter()
+            # batch-recover + cache every sender in one native call
+            recover_senders(block.body.transactions)
+            ph["senders"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            if self.validate_headers:
+                self.header_validator.validate(header, prev)
+            BlockValidator.validate_body(block)
+            OmmersValidator.validate(
+                self.blockchain, block,
+                header_lookup=window_headers_full.get,
+                block_lookup=window_blocks.get,
+                header_validator=(
+                    self.header_validator
+                    if self.validate_headers else None
+                ),
+            )
+            config = for_block(header.number, self.config.blockchain)
+            if not config.byzantium:
+                raise ValueError(
+                    "window commits need Byzantium receipts "
+                    "(pre-Byzantium receipts embed per-tx roots)"
+                )
+            ph["validate"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            result = execute_block(
+                block,
+                b"",  # the open session IS the parent state
+                committer.make_world,
+                self.config,
+                validate=True,
+                check_root=False,  # deferred to window finalize
+            )
+            ph["execute"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            committer.commit_block(result.world, header)
+            ph["commit"] += time.perf_counter() - t0
+            window_headers[header.number] = header.hash
+            window_headers_full[header.number] = header
+            window_blocks[header.number] = block
+            results_cur.append((block, result))
+            prev = header
+            if len(results_cur) >= window_size:
+                # the PREVIOUS window must be collected before seal:
+                # seal substitutes its resolved hashes into this one
+                while in_flight:
+                    collect_one()
+                blocks_since_reset += len(results_cur)
+                t0 = time.perf_counter()
+                in_flight.append((committer.seal(), results_cur))
+                ph["seal"] += time.perf_counter() - t0
+                results_cur = []
+                if blocks_since_reset >= epoch:
+                    # collect the just-sealed window, then restart the
+                    # session from its validated root (memory bound)
+                    while in_flight:
+                        collect_one()
+                    committer = make_committer(prev.state_root)
+                    blocks_since_reset = 0
+                    # header/body maps: ommers reach back 6 ancestors,
+                    # BLOCKHASH 256 — prune beyond that
+                    for d, keep in (
+                        (window_headers, 260),
+                        (window_headers_full, 8),
+                        (window_blocks, 8),
+                    ):
+                        for n in sorted(d)[:-keep]:
+                            del d[n]
+        while in_flight:
+            collect_one()
+        if results_cur:
+            t0 = time.perf_counter()
+            job = committer.seal()
+            ph["seal"] += time.perf_counter() - t0
+            in_flight.append((job, results_cur))
+            collect_one()
         stats.seconds = time.perf_counter() - t_start
         return stats
 

@@ -86,8 +86,10 @@ def _build_fused(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
 
     sig: per class (nblocks, nrows, nsubs), nrows % TILE == 0.
     Inputs: for each class, enc u8[nrows, nblocks*RATE]; then for each
-    class rows32 i32[nsubs*32], cols32 i32[nsubs*32], child i32[nsubs].
-    Output: per-class digest u8[nrows, 32].
+    class rows i32[nsubs], offs i32[nsubs], child i32[nsubs] — the
+    x32 byte-index expansion happens ON DEVICE (uploading pre-expanded
+    index arrays tripled the per-window transfer through the tunnel).
+    Output: concatenated digests u8[sum nrows, 32].
 
     ``use_jnp``: hash via the jnp sponge (XLA-compiled, the CPU/test
     path) instead of the Pallas kernel (TPU) — pallas interpret mode is
@@ -115,20 +117,25 @@ def _build_fused(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
         subs = args[k:]
 
         def hash_all(encs):
-            return [runners[c](encs[c]) for c in range(k)]
+            return jnp.concatenate(
+                [runners[c](encs[c]) for c in range(k)], axis=0
+            )  # [sum rows, 32] u8 — ONE output array, one host fetch
+
+        idx32 = jnp.arange(32, dtype=jnp.int32)
 
         def body(_, carry):
             encs, _ = carry
-            digs = hash_all(encs)
-            G = jnp.concatenate(digs, axis=0)  # [sum rows, 32] u8
+            G = hash_all(encs)
             new_encs = []
             for c in range(k):
-                rows32 = subs[3 * c]
-                cols32 = subs[3 * c + 1]
+                rows = subs[3 * c]
+                offs = subs[3 * c + 1]
                 child = subs[3 * c + 2]
+                rows32 = jnp.repeat(rows, 32)
+                cols32 = (offs[:, None] + idx32).reshape(-1)
                 vals = G[child].reshape(-1)  # [nsubs*32] u8
                 new_encs.append(encs[c].at[rows32, cols32].set(vals))
-            return new_encs, digs
+            return new_encs, G
 
         encs, digs = jax.lax.fori_loop(
             0, rounds, body, (encs, hash_all(encs))
@@ -138,21 +145,58 @@ def _build_fused(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
     return run
 
 
+class FusedJob:
+    """In-flight fused finalize: the device dispatch has been issued
+    (asynchronously — JAX returns before the TPU finishes) but digests
+    have not been fetched. ``collect`` blocks on the single device->host
+    transfer. This is the double-buffering seam: the caller executes the
+    NEXT window's transactions on the host while this window's fixpoint
+    program runs on device (SURVEY §7.4-5)."""
+
+    __slots__ = ("digests", "class_rows")
+
+    def __init__(self, digests, class_rows):
+        self.digests = digests  # device u8[sum rows, 32]
+        self.class_rows = class_rows  # [(phs in row order, global base)]
+
+    def collect(self) -> Dict[bytes, bytes]:
+        if self.digests is None:
+            return {}
+        import jax
+
+        d = np.asarray(jax.device_get(self.digests))
+        out: Dict[bytes, bytes] = {}
+        for rows, base in self.class_rows:
+            for r, ph in enumerate(rows):
+                out[ph] = d[base + r].tobytes()
+        return out
+
+
 def fused_resolve(
     to_resolve: Dict[bytes, bytes],
     deps: Dict[bytes, List[bytes]],
     prefix: bytes,
     use_jnp: bool = False,
 ) -> Dict[bytes, bytes]:
-    """Resolve placeholder -> real Keccak-256 hash for every entry of
-    ``to_resolve`` (placeholder -> raw encoding) in one device dispatch.
+    return fused_submit(to_resolve, deps, prefix, use_jnp).collect()
+
+
+def fused_submit(
+    to_resolve: Dict[bytes, bytes],
+    deps: Dict[bytes, List[bytes]],
+    prefix: bytes,
+    use_jnp: bool = False,
+) -> FusedJob:
+    """Pack + dispatch the fixpoint program that resolves placeholder ->
+    real Keccak-256 hash for every entry of ``to_resolve`` (placeholder
+    -> raw encoding); returns without waiting for the device.
 
     ``deps`` is the child map from deferred.finalize (already restricted
     to session-known placeholders); ``prefix`` is the session's
     placeholder prefix for the offset scan.
     """
     if not to_resolve:
-        return {}
+        return FusedJob(None, [])
     depth = len(topo_levels(deps))
     if depth > MAX_DEPTH:
         raise FusedUnsupported(f"DAG depth {depth} > {MAX_DEPTH}")
@@ -219,32 +263,26 @@ def fused_resolve(
         # compile on the first window that hits it)
         nsubs = _pow2(len(subs) + 1, floor=1024 if use_jnp else 4096)
         dummy_row = nrows_pad[nb] - 1  # guaranteed padding row
-        while len(subs) < nsubs:
-            subs.append((dummy_row, 0, 0))
-        rows32 = np.empty(nsubs * 32, dtype=np.int32)
-        cols32 = np.empty(nsubs * 32, dtype=np.int32)
-        child = np.empty(nsubs, dtype=np.int32)
-        for m, (r, off, cp) in enumerate(subs):
-            rows32[m * 32 : (m + 1) * 32] = r
-            cols32[m * 32 : (m + 1) * 32] = np.arange(
-                off, off + 32, dtype=np.int32
-            )
-            child[m] = cp
+        sub_np = np.full((nsubs, 3), (dummy_row, 0, 0), dtype=np.int32)
+        if subs:
+            sub_np[: len(subs)] = subs
         enc_bufs.append(buf)
-        sub_arrays.extend([rows32, cols32, child])
+        sub_arrays.extend(
+            [
+                np.ascontiguousarray(sub_np[:, 0]),
+                np.ascontiguousarray(sub_np[:, 1]),
+                np.ascontiguousarray(sub_np[:, 2]),
+            ]
+        )
         sig.append((nb, nrows_pad[nb], nsubs))
 
     rounds = _pow2(depth, floor=8)  # coarse: depth 5 and 8 share a compile
     run = _build_fused(tuple(sig), rounds, use_jnp)
-    import jax
 
-    digs = run(*[*enc_bufs, *sub_arrays])
-    digs = [np.asarray(jax.device_get(d)) for d in digs]
-
-    out: Dict[bytes, bytes] = {}
-    for ci, nb in enumerate(class_list):
-        rows = classes[nb]
-        d = digs[ci]
-        for r, ph in enumerate(rows):
-            out[ph] = d[r].tobytes()
-    return out
+    digests = run(*[*enc_bufs, *sub_arrays])  # async: no host sync here
+    class_rows = []
+    base = 0
+    for nb in class_list:
+        class_rows.append((classes[nb], base))
+        base += nrows_pad[nb]
+    return FusedJob(digests, class_rows)

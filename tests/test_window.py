@@ -181,3 +181,76 @@ class TestWindowedReplay:
         acc = bc.get_account(ADDRS[2], root)
         assert acc.balance == 1000 * ETH + 123 - 2 * (21000 * 10**9 + 1)
         assert acc.nonce == 2
+
+    def test_epoch_reset_and_staged_prune(self, chain):
+        """Pipelined session hygiene: collected windows drop their
+        staged encodings (reads fall back through the resolved map to
+        the persisted store), and the epoch reset rebuilds the session
+        committer mid-replay without changing any result."""
+        blocks, caddr = chain
+        cfg = window_cfg(2)
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
+        driver = ReplayDriver(bc, cfg)
+        driver.session_epoch_blocks = 2  # reset after every window
+        stats = driver.replay(blocks)
+        assert stats.blocks == 5
+        assert bc.get_header_by_number(5).hash == blocks[-1].hash
+        # persisted-store-only reads still see everything
+        fresh = Blockchain(bc.storages, cfg)
+        world = fresh.get_world_state(blocks[-1].header.state_root)
+        assert world.get_storage(caddr, 0) == 42
+        report = verify_reachable(
+            bc.storages.account_node_storage,
+            bc.storages.storage_node_storage,
+            bc.storages.evmcode_storage,
+            blocks[-1].header.state_root,
+        )
+        assert report.missing == 0
+
+    def test_collect_prunes_session_memory(self, chain):
+        """After every window is collected the committer's staged dict
+        holds nothing (all placeholders resolved + pruned)."""
+        from khipu_tpu.ledger.window import WindowCommitter
+
+        blocks, _ = chain
+        cfg = window_cfg(5)
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
+        seen = []
+        orig = WindowCommitter.collect
+
+        def spy(self, job):
+            r = orig(self, job)
+            seen.append((len(self._staged), len(self._resolved_global)))
+            return r
+
+        WindowCommitter.collect = spy
+        try:
+            ReplayDriver(bc, cfg).replay(blocks)
+        finally:
+            WindowCommitter.collect = orig
+        assert seen, "collect never ran"
+        staged_left, resolved = seen[-1]
+        assert staged_left == 0
+        assert resolved > 0
+
+    def test_mismatch_after_pipeline_overlap_persists_nothing(self, chain):
+        """A root mismatch in window N surfaces at collect(N) — after
+        window N+1 already executed optimistically. Nothing from either
+        window may reach the persisted block storage."""
+        blocks, _ = chain
+        cfg = window_cfg(2)
+        bad = Block(
+            dataclasses.replace(blocks[1].header, state_root=b"\x55" * 32),
+            blocks[1].body,
+        )
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
+        with pytest.raises(WindowMismatch) as e:
+            ReplayDriver(bc, cfg, validate_headers=False).replay(
+                [blocks[0], bad, blocks[2], blocks[3]]
+            )
+        assert e.value.number == 2
+        assert bc.get_header_by_number(1) is None
+        assert bc.get_header_by_number(2) is None
