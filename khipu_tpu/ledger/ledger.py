@@ -318,6 +318,9 @@ def execute_block(
     probe = make_world(parent_state_root)
     block_env.get_block_hash = probe.get_block_hash
     txs = list(block.body.transactions)
+    from khipu_tpu.domain.transaction import recover_senders
+
+    recover_senders(txs)  # one native batch call; caches per-tx
     senders = [stx.sender for stx in txs]
     t0 = time.perf_counter()
     stats = Stats(tx_count=len(txs))

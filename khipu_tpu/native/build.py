@@ -11,10 +11,15 @@ from typing import Optional
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _CSRC = os.path.join(_DIR, "csrc")
 _OUT = os.path.join(_DIR, "_libkhipu_native.so")
+_CSRC_EXT = os.path.join(_DIR, "csrc_ext")
+_OUT_EXT = os.path.join(_DIR, "_khipu_rlp_ext.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _failed = False
+_ext_lock = threading.Lock()
+_ext_mod = None
+_ext_failed = False
 
 
 def _sources():
@@ -67,3 +72,47 @@ def load_library() -> Optional[ctypes.CDLL]:
             _failed = True
             _lib = None
         return _lib
+
+
+def load_rlp_ext():
+    """Compile (if stale) and import the CPython RLP extension module
+    (csrc_ext/rlp_ext.c). Returns the module or None — callers fall
+    back to the pure-Python codec."""
+    global _ext_mod, _ext_failed
+    if _ext_mod is not None or _ext_failed:
+        return _ext_mod
+    with _ext_lock:
+        if _ext_mod is not None or _ext_failed:
+            return _ext_mod
+        try:
+            import importlib.util
+            import sysconfig
+
+            src = os.path.join(_CSRC_EXT, "rlp_ext.c")
+            if not os.path.exists(_OUT_EXT) or (
+                os.path.getmtime(src) > os.path.getmtime(_OUT_EXT)
+            ):
+                tmp = f"{_OUT_EXT}.{os.getpid()}.tmp"
+                cmd = [
+                    "gcc", "-O3", "-shared", "-fPIC",
+                    f"-I{sysconfig.get_paths()['include']}",
+                    "-o", tmp, src,
+                ]
+                try:
+                    subprocess.run(
+                        cmd, check=True, capture_output=True, timeout=300
+                    )
+                    os.replace(tmp, _OUT_EXT)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+            spec = importlib.util.spec_from_file_location(
+                "khipu_rlp_ext", _OUT_EXT
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _ext_mod = mod
+        except Exception:
+            _ext_failed = True
+            _ext_mod = None
+        return _ext_mod

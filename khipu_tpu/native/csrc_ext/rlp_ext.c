@@ -1,0 +1,276 @@
+/* RLP codec as a CPython extension — the hot host loop of trie commits.
+ *
+ * Semantics are bit-identical to khipu_tpu/base/rlp.py (the pure-Python
+ * reference implementation, kept as the no-toolchain fallback and as
+ * the differential oracle in tests): Yellow Paper appendix B encoding,
+ * canonical-form enforcement on decode, MAX_DEPTH nesting cap.
+ * Role parity: khipu-base/src/main/scala/khipu/rlp/RLP.scala:35.
+ *
+ * Errors raise the exception class installed via _set_error (the
+ * package passes base.rlp.RLPError so callers see one exception type
+ * regardless of backend).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define MAX_DEPTH 64
+
+static PyObject *rlp_error = NULL; /* set via _set_error */
+
+static void set_err(const char *msg) {
+  PyErr_SetString(rlp_error ? rlp_error : PyExc_ValueError, msg);
+}
+
+/* ------------------------------------------------------------ encode */
+
+static int enc_size(PyObject *o, Py_ssize_t *out, int depth) {
+  const char *buf;
+  Py_ssize_t n;
+  if (PyBytes_CheckExact(o)) {
+    buf = PyBytes_AS_STRING(o);
+    n = PyBytes_GET_SIZE(o);
+  } else if (PyByteArray_CheckExact(o)) {
+    buf = PyByteArray_AS_STRING(o);
+    n = PyByteArray_GET_SIZE(o);
+  } else if (PyList_CheckExact(o) || PyTuple_CheckExact(o)) {
+    if (depth >= MAX_DEPTH) {
+      set_err("RLP nesting exceeds MAX_DEPTH");
+      return -1;
+    }
+    int is_list = PyList_CheckExact(o);
+    Py_ssize_t k = is_list ? PyList_GET_SIZE(o) : PyTuple_GET_SIZE(o);
+    Py_ssize_t total = 0;
+    for (Py_ssize_t i = 0; i < k; ++i) {
+      PyObject *c = is_list ? PyList_GET_ITEM(o, i) : PyTuple_GET_ITEM(o, i);
+      Py_ssize_t s;
+      if (enc_size(c, &s, depth + 1) < 0) return -1;
+      total += s;
+    }
+    if (total < 56) {
+      *out = 1 + total;
+    } else {
+      Py_ssize_t l = total, lb = 0;
+      while (l) { lb++; l >>= 8; }
+      *out = 1 + lb + total;
+    }
+    return 0;
+  } else {
+    set_err("cannot RLP-encode object (want bytes or list)");
+    return -1;
+  }
+  if (n == 1 && (unsigned char)buf[0] < 0x80) {
+    *out = 1;
+  } else if (n < 56) {
+    *out = 1 + n;
+  } else {
+    Py_ssize_t l = n, lb = 0;
+    while (l) { lb++; l >>= 8; }
+    *out = 1 + lb + n;
+  }
+  return 0;
+}
+
+static char *write_len(char *p, Py_ssize_t n, unsigned char offset) {
+  if (n < 56) {
+    *p++ = (char)(offset + n);
+    return p;
+  }
+  unsigned char tmp[sizeof(Py_ssize_t)];
+  int lb = 0;
+  Py_ssize_t l = n;
+  while (l) { tmp[lb++] = (unsigned char)(l & 0xFF); l >>= 8; }
+  *p++ = (char)(offset + 55 + lb);
+  for (int i = lb - 1; i >= 0; --i) *p++ = (char)tmp[i];
+  return p;
+}
+
+static char *enc_write(PyObject *o, char *p, int depth) {
+  const char *buf;
+  Py_ssize_t n;
+  if (PyBytes_CheckExact(o)) {
+    buf = PyBytes_AS_STRING(o);
+    n = PyBytes_GET_SIZE(o);
+  } else if (PyByteArray_CheckExact(o)) {
+    buf = PyByteArray_AS_STRING(o);
+    n = PyByteArray_GET_SIZE(o);
+  } else {
+    int is_list = PyList_CheckExact(o);
+    Py_ssize_t k = is_list ? PyList_GET_SIZE(o) : PyTuple_GET_SIZE(o);
+    Py_ssize_t total = 0;
+    for (Py_ssize_t i = 0; i < k; ++i) {
+      PyObject *c = is_list ? PyList_GET_ITEM(o, i) : PyTuple_GET_ITEM(o, i);
+      Py_ssize_t s;
+      if (enc_size(c, &s, depth + 1) < 0) return NULL;
+      total += s;
+    }
+    p = write_len(p, total, 0xC0);
+    for (Py_ssize_t i = 0; i < k; ++i) {
+      PyObject *c = is_list ? PyList_GET_ITEM(o, i) : PyTuple_GET_ITEM(o, i);
+      p = enc_write(c, p, depth + 1);
+      if (p == NULL) return NULL;
+    }
+    return p;
+  }
+  if (n == 1 && (unsigned char)buf[0] < 0x80) {
+    *p++ = buf[0];
+    return p;
+  }
+  p = write_len(p, n, 0x80);
+  memcpy(p, buf, n);
+  return p + n;
+}
+
+static PyObject *py_encode(PyObject *self, PyObject *o) {
+  Py_ssize_t size;
+  if (enc_size(o, &size, 0) < 0) return NULL;
+  PyObject *out = PyBytes_FromStringAndSize(NULL, size);
+  if (!out) return NULL;
+  char *end = enc_write(o, PyBytes_AS_STRING(out), 0);
+  if (end == NULL) {
+    Py_DECREF(out);
+    return NULL;
+  }
+  return out;
+}
+
+/* ------------------------------------------------------------ decode */
+
+static PyObject *dec_at(const unsigned char *d, Py_ssize_t len,
+                        Py_ssize_t pos, Py_ssize_t *end_out, int depth);
+
+static PyObject *dec_list(const unsigned char *d, Py_ssize_t len,
+                          Py_ssize_t start, Py_ssize_t end, int depth) {
+  if (depth >= MAX_DEPTH) {
+    set_err("RLP nesting exceeds MAX_DEPTH");
+    return NULL;
+  }
+  PyObject *items = PyList_New(0);
+  if (!items) return NULL;
+  Py_ssize_t pos = start;
+  while (pos < end) {
+    Py_ssize_t next;
+    PyObject *item = dec_at(d, len, pos, &next, depth + 1);
+    if (!item) { Py_DECREF(items); return NULL; }
+    if (next > end) {
+      Py_DECREF(item);
+      Py_DECREF(items);
+      set_err("list element overruns list payload");
+      return NULL;
+    }
+    if (PyList_Append(items, item) < 0) {
+      Py_DECREF(item);
+      Py_DECREF(items);
+      return NULL;
+    }
+    Py_DECREF(item);
+    pos = next;
+  }
+  return items;
+}
+
+static PyObject *dec_at(const unsigned char *d, Py_ssize_t len,
+                        Py_ssize_t pos, Py_ssize_t *end_out, int depth) {
+  if (pos >= len) {
+    set_err("truncated RLP input");
+    return NULL;
+  }
+  unsigned char b0 = d[pos];
+  if (b0 < 0x80) {
+    *end_out = pos + 1;
+    return PyBytes_FromStringAndSize((const char *)d + pos, 1);
+  }
+  if (b0 <= 0xB7) { /* short string */
+    Py_ssize_t n = b0 - 0x80;
+    Py_ssize_t end = pos + 1 + n;
+    if (end > len) { set_err("truncated string"); return NULL; }
+    if (n == 1 && d[pos + 1] < 0x80) {
+      set_err("non-canonical single byte");
+      return NULL;
+    }
+    *end_out = end;
+    return PyBytes_FromStringAndSize((const char *)d + pos + 1, n);
+  }
+  if (b0 <= 0xBF) { /* long string */
+    Py_ssize_t ll = b0 - 0xB7;
+    if (pos + 1 + ll > len) { set_err("truncated length"); return NULL; }
+    Py_ssize_t n = 0;
+    for (Py_ssize_t i = 0; i < ll; ++i) {
+      if (n > (PY_SSIZE_T_MAX >> 8)) { set_err("length overflow"); return NULL; }
+      n = (n << 8) | d[pos + 1 + i];
+    }
+    if (n < 56 || (ll > 1 && d[pos + 1] == 0)) {
+      set_err("non-canonical length");
+      return NULL;
+    }
+    Py_ssize_t start = pos + 1 + ll;
+    Py_ssize_t end = start + n;
+    if (end > len) { set_err("truncated string"); return NULL; }
+    *end_out = end;
+    return PyBytes_FromStringAndSize((const char *)d + start, n);
+  }
+  if (b0 <= 0xF7) { /* short list */
+    Py_ssize_t n = b0 - 0xC0;
+    Py_ssize_t end = pos + 1 + n;
+    if (end > len) { set_err("truncated list"); return NULL; }
+    PyObject *items = dec_list(d, len, pos + 1, end, depth);
+    if (!items) return NULL;
+    *end_out = end;
+    return items;
+  }
+  /* long list */
+  Py_ssize_t ll = b0 - 0xF7;
+  if (pos + 1 + ll > len) { set_err("truncated length"); return NULL; }
+  Py_ssize_t n = 0;
+  for (Py_ssize_t i = 0; i < ll; ++i) {
+    if (n > (PY_SSIZE_T_MAX >> 8)) { set_err("length overflow"); return NULL; }
+    n = (n << 8) | d[pos + 1 + i];
+  }
+  if (n < 56 || (ll > 1 && d[pos + 1] == 0)) {
+    set_err("non-canonical length");
+    return NULL;
+  }
+  Py_ssize_t start = pos + 1 + ll;
+  Py_ssize_t end = start + n;
+  if (end > len) { set_err("truncated list"); return NULL; }
+  PyObject *items = dec_list(d, len, start, end, depth);
+  if (!items) return NULL;
+  *end_out = end;
+  return items;
+}
+
+static PyObject *py_decode(PyObject *self, PyObject *arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+  Py_ssize_t end;
+  PyObject *item =
+      dec_at((const unsigned char *)view.buf, view.len, 0, &end, 0);
+  if (item && end != view.len) {
+    Py_DECREF(item);
+    item = NULL;
+    set_err("trailing bytes after RLP item");
+  }
+  PyBuffer_Release(&view);
+  return item;
+}
+
+static PyObject *py_set_error(PyObject *self, PyObject *cls) {
+  Py_XINCREF(cls);
+  Py_XDECREF(rlp_error);
+  rlp_error = cls;
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"encode", py_encode, METH_O, "RLP-encode bytes / nested lists."},
+    {"decode", py_decode, METH_O, "RLP-decode one item (strict)."},
+    {"_set_error", py_set_error, METH_O, "Install the error class."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "khipu_rlp_ext", NULL, -1, methods,
+};
+
+PyMODINIT_FUNC PyInit_khipu_rlp_ext(void) {
+  return PyModule_Create(&moduledef);
+}

@@ -124,12 +124,21 @@ def _encode(node: _StructNode):
 def bulk_build(
     pairs: Iterable[Tuple[bytes, bytes]],
     hasher: Hasher = host_hasher,
+    fused: bool = False,
+    stats_out: Optional[Dict[str, float]] = None,
 ) -> Tuple[bytes, Dict[bytes, bytes]]:
     """Build a fresh MPT from (key, value) pairs.
 
     Returns ``(root_hash, {node_hash: node_rlp})`` — the node dict is
     what a NodeDataSource persist of the same trie would contain.
     Duplicate keys: last write wins. Empty input → empty trie hash.
+
+    ``fused``: resolve the ENTIRE node DAG in one device dispatch (the
+    trie/fused.py fixpoint program) instead of one hasher call per trie
+    level — O(levels) dispatch round-trips collapse to one, the same
+    fix the windowed replay commit got. ``stats_out`` (a dict) receives
+    ``device_s``: seconds spent in the device resolve, for the bench's
+    host/device split.
     """
     from khipu_tpu.trie.mpt import EMPTY_TRIE_HASH
 
@@ -145,6 +154,21 @@ def bulk_build(
     root = _build_struct(items, 0)
     levels = _measure_heights(root)
 
+    if fused:
+        nodes = _resolve_fused(levels, stats_out)
+    else:
+        nodes = _resolve_levels(levels, hasher)
+
+    if isinstance(root.ref, bytes) and len(root.ref) == 32:
+        root_hash = root.ref
+    else:  # inline root is still stored by hash (mpt.persist parity)
+        root_hash = keccak256(root.encoded)
+        nodes[root_hash] = root.encoded
+    return root_hash, nodes
+
+
+def _resolve_levels(levels, hasher: Hasher) -> Dict[bytes, bytes]:
+    """One batched hasher call per tree height (the portable path)."""
     nodes: Dict[bytes, bytes] = {}
     for level in levels:
         to_hash: List[_StructNode] = []
@@ -162,10 +186,63 @@ def bulk_build(
             for node, digest in zip(to_hash, hasher(msgs)):
                 node.ref = digest
                 nodes[digest] = node.encoded
+    return nodes
 
-    if isinstance(root.ref, bytes) and len(root.ref) == 32:
-        root_hash = root.ref
-    else:  # inline root is still stored by hash (mpt.persist parity)
-        root_hash = keccak256(root.encoded)
-        nodes[root_hash] = root.encoded
-    return root_hash, nodes
+
+def _resolve_fused(levels, stats_out=None) -> Dict[bytes, bytes]:
+    """Whole-DAG resolve in ONE device dispatch: encode bottom-up with
+    32-byte placeholder refs (the inline-or-hash decision only needs
+    LENGTHS, and a placeholder is exactly hash-sized), then run the
+    fused fixpoint (trie/fused.py). Bit-exact with the level loop —
+    the same substitution-length invariant the windowed commit relies
+    on."""
+    import time as _time
+
+    import jax
+
+    from khipu_tpu.trie.deferred import (
+        _PLACEHOLDER_PREFIX,
+        _make_placeholder,
+        _substitute_bytes,
+    )
+    from khipu_tpu.trie.fused import fused_submit
+
+    counter = 0
+    to_resolve: Dict[bytes, bytes] = {}
+    ph_nodes: List[Tuple[bytes, _StructNode]] = []
+    for level in levels:  # leaves first: children encode before parents
+        for node in level:
+            struct = _encode(node)
+            encoded = rlp_encode(struct)
+            node.encoded = encoded
+            if len(encoded) < 32:
+                node.ref = struct
+                continue
+            ph = _make_placeholder(counter)
+            counter += 1
+            to_resolve[ph] = encoded
+            node.ref = ph
+            ph_nodes.append((ph, node))
+
+    # deps feed only the topological depth scan, and the exact depth is
+    # already known from the height pass — pass empty child lists
+    t0 = _time.perf_counter()
+    job = fused_submit(
+        to_resolve, {}, _PLACEHOLDER_PREFIX,
+        use_jnp=jax.default_backend() != "tpu",
+        depth=len(levels),
+    )
+    t1 = _time.perf_counter()
+    mapping = job.collect()
+    if stats_out is not None:
+        # pack+dispatch is HOST work; device_s is the wait+fetch only
+        stats_out["pack_s"] = t1 - t0
+        stats_out["device_s"] = _time.perf_counter() - t1
+
+    nodes: Dict[bytes, bytes] = {}
+    for ph, node in ph_nodes:
+        real = mapping[ph]
+        node.encoded = _substitute_bytes(node.encoded, mapping)
+        node.ref = real
+        nodes[real] = node.encoded
+    return nodes

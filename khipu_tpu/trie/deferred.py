@@ -183,11 +183,14 @@ def _collect_placeholders(structure, out: List[bytes], known) -> None:
 
 def resolution_inputs(trie: DeferredMPT, subset=None):
     """(to_resolve, deps, structures) for a deferred session — the
-    placeholder set a resolver must hash and its dependency map. THE
-    single derivation used by finalize (both paths), the sharded
+    placeholder set a resolver must hash and its dependency map. The
+    decode-based derivation used by finalize (both paths), the sharded
     resolver, the dryrun and the tests; ``subset`` restricts to given
     placeholders (finalize's live-only mode) while membership (`known`)
-    always spans every placeholder the session handed out."""
+    always spans every placeholder the session handed out.
+    WindowCommitter.seal keeps a raw-byte-scan sibling (counter-range +
+    pre-substitution, no decode) — test_seal_scan_matches_resolution_
+    inputs pins the two against divergence."""
     staged = {
         ph: enc for ph, enc in trie._staged.items() if _is_placeholder(ph)
     }

@@ -177,8 +177,9 @@ def fused_resolve(
     deps: Dict[bytes, List[bytes]],
     prefix: bytes,
     use_jnp: bool = False,
+    depth: int = None,
 ) -> Dict[bytes, bytes]:
-    return fused_submit(to_resolve, deps, prefix, use_jnp).collect()
+    return fused_submit(to_resolve, deps, prefix, use_jnp, depth).collect()
 
 
 def fused_submit(
@@ -186,6 +187,7 @@ def fused_submit(
     deps: Dict[bytes, List[bytes]],
     prefix: bytes,
     use_jnp: bool = False,
+    depth: int = None,
 ) -> FusedJob:
     """Pack + dispatch the fixpoint program that resolves placeholder ->
     real Keccak-256 hash for every entry of ``to_resolve`` (placeholder
@@ -193,11 +195,14 @@ def fused_submit(
 
     ``deps`` is the child map from deferred.finalize (already restricted
     to session-known placeholders); ``prefix`` is the session's
-    placeholder prefix for the offset scan.
+    placeholder prefix for the offset scan. Callers that know the DAG
+    depth (bulk build has it from the height pass) pass ``depth`` to
+    skip the O(depth x nodes) topological scan.
     """
     if not to_resolve:
         return FusedJob(None, [])
-    depth = len(topo_levels(deps))
+    if depth is None:
+        depth = len(topo_levels(deps))
     if depth > MAX_DEPTH:
         raise FusedUnsupported(f"DAG depth {depth} > {MAX_DEPTH}")
 

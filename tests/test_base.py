@@ -155,3 +155,57 @@ class TestHexPrefix:
             nib = bytes(i % 16 for i in range(n))
             for leaf in (False, True):
                 assert hp_decode(hp_encode(nib, leaf)) == (nib, leaf)
+
+
+class TestNativeRLPCodec:
+    """The C-extension RLP codec (native/csrc_ext/rlp_ext.c) must be
+    bit-identical to the pure-Python reference, including canonical-
+    form rejection and the nesting cap."""
+
+    def test_differential_fuzz(self):
+        import random
+
+        from khipu_tpu.base import rlp as R
+
+        rng = random.Random(99)
+
+        def rand_item(depth=0):
+            if depth > 3 or rng.random() < 0.6:
+                return rng.randbytes(rng.randint(0, 90))
+            return [rand_item(depth + 1) for _ in range(rng.randint(0, 6))]
+
+        def norm(x):
+            if isinstance(x, list):
+                return [norm(i) for i in x]
+            return bytes(x)
+
+        for _ in range(500):
+            it = rand_item()
+            enc = R.rlp_encode(it)
+            assert enc == R._py_rlp_encode(it)
+            assert R.rlp_decode(enc) == norm(it)
+            assert R._py_rlp_decode(enc) == R.rlp_decode(enc)
+
+    def test_error_parity(self):
+        import pytest as _pytest
+
+        from khipu_tpu.base import rlp as R
+
+        for bad in (b"", b"\x81\x05", b"\xb8\x01a", b"\xc1", b"\x80x"):
+            with _pytest.raises(R.RLPError):
+                R.rlp_decode(bad)
+            with _pytest.raises(R.RLPError):
+                R._py_rlp_decode(bad)
+
+    def test_depth_cap(self):
+        import pytest as _pytest
+
+        from khipu_tpu.base import rlp as R
+
+        deep = [b"h"]
+        for _ in range(R.MAX_DEPTH + 5):
+            deep = [deep]
+        with _pytest.raises(R.RLPError):
+            R.rlp_encode(deep)
+        with _pytest.raises(R.RLPError):
+            R._py_rlp_encode(deep)

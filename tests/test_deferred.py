@@ -285,3 +285,30 @@ class TestFusedFinalize:
         stats = driver.replay(blocks)
         assert stats.blocks == 9
         assert bc.get_header_by_number(9).hash == blocks[-1].hash
+
+
+def test_seal_scan_matches_resolution_inputs():
+    """WindowCommitter.seal derives its placeholder DAG with a raw
+    byte scan (no rlp decode); deferred.resolution_inputs derives it
+    from decoded structures. The two scanners must agree on the same
+    session — this pins them against silent divergence (they share the
+    placeholder format and the embedded-ref rules)."""
+    from khipu_tpu.domain.account import Account, address_key
+    from khipu_tpu.ledger.window import WindowCommitter
+    from khipu_tpu.storage.storages import Storages
+    from khipu_tpu.trie.deferred import resolution_inputs
+    from khipu_tpu.trie.mpt import EMPTY_TRIE_HASH
+
+    committer = WindowCommitter(Storages(), EMPTY_TRIE_HASH)
+    trie = committer.account_trie
+    for i in range(40):
+        acc = Account(nonce=i, balance=10**18 + i)
+        trie = trie.put(address_key(i.to_bytes(20, "big")), acc.encode())
+    committer.account_trie = trie
+    want_resolve, want_deps, _ = resolution_inputs(trie)
+
+    job = committer.seal()
+    assert set(job.to_resolve) == set(want_resolve)
+    # seal pre-substitutes resolved placeholders; with none resolved
+    # yet the encodings must be byte-identical too
+    assert job.to_resolve == want_resolve

@@ -234,3 +234,26 @@ def test_hash_aliased_nodes_survive_removal():
 
 def test_empty_trie_hash_literal():
     assert EMPTY_TRIE_HASH == keccak256(rlp_encode(b""))
+
+
+@pytest.mark.parametrize("seed", [2, 11])
+def test_fused_bulk_equals_level_loop(seed):
+    """The one-dispatch fused bulk resolve (trie/bulk._resolve_fused)
+    is bit-exact with the per-level hasher loop: same root, same
+    content-addressed node set — including inline (<32 B) capping and
+    embedded-child substitution."""
+    rng = random.Random(seed)
+    pairs = {
+        rng.randbytes(rng.randint(1, 40)): rng.randbytes(rng.randint(1, 90))
+        for _ in range(1500)
+    }
+    r1, n1 = bulk_build(pairs.items(), hasher=host_hasher)
+    r2, n2 = bulk_build(pairs.items(), fused=True)
+    assert r1 == r2
+    assert n1 == n2
+    # tiny tries incl. inline-root edge
+    for k in (1, 2, 3, 9):
+        sub = dict(list(pairs.items())[:k])
+        assert bulk_build(sub.items(), fused=True) == bulk_build(
+            sub.items(), hasher=host_hasher
+        )
