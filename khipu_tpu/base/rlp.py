@@ -145,11 +145,15 @@ rlp_decode = _py_rlp_decode  # rebound to the C codec below when built
 # of trie commits (every node rebuild encodes; every node read
 # decodes). The pure-Python implementations above remain the
 # no-toolchain fallback and the differential oracle (tests fuzz
-# equality). Binding happens at module import when the compiled .so is
-# already fresh (a dlopen, microseconds); a MISSING/stale .so compiles
-# on a background thread and swaps the module bindings when ready, so
-# cold checkouts never stall their first import on a gcc subprocess.
-def _bind_rlp_ext() -> bool:
+# equality).
+#
+# Binding: a FRESH .so binds directly at import (a dlopen; zero
+# per-call overhead, the steady-state case). A missing/stale .so
+# compiles on a background thread; until it lands, the module exports
+# one-hop forwarders whose target is swapped on completion — so even
+# callers that imported the names BY VALUE during the compile get the
+# fast codec, and no import ever stalls on a gcc subprocess.
+def _bind_rlp_ext(forwarded: bool) -> bool:
     global rlp_encode, rlp_decode
     try:
         from khipu_tpu.native.build import load_rlp_ext
@@ -158,6 +162,9 @@ def _bind_rlp_ext() -> bool:
         if ext is None:
             return False
         ext._set_error(RLPError)
+        if forwarded:
+            _impl[0] = ext.encode
+            _impl[1] = ext.decode
         rlp_encode = ext.encode  # type: ignore[assignment]
         rlp_decode = ext.decode  # type: ignore[assignment]
         return True
@@ -165,22 +172,28 @@ def _bind_rlp_ext() -> bool:
         return False
 
 
+_impl = [_py_rlp_encode, _py_rlp_decode]
+
+
 def _init_rlp_ext() -> None:
-    import os
+    from khipu_tpu.native.build import rlp_ext_is_fresh
 
-    from khipu_tpu.native import build as _b
-
-    src = os.path.join(_b._CSRC_EXT, "rlp_ext.c")
-    fresh = os.path.exists(_b._OUT_EXT) and (
-        not os.path.exists(src)
-        or os.path.getmtime(src) <= os.path.getmtime(_b._OUT_EXT)
-    )
-    if fresh:
-        _bind_rlp_ext()
+    if rlp_ext_is_fresh():
+        _bind_rlp_ext(forwarded=False)
     else:
+        global rlp_encode, rlp_decode
+
+        def rlp_encode(item):  # noqa: F811 - forwarder until compiled
+            return _impl[0](item)
+
+        def rlp_decode(data):  # noqa: F811
+            return _impl[1](data)
+
         import threading
 
-        threading.Thread(target=_bind_rlp_ext, daemon=True).start()
+        threading.Thread(
+            target=_bind_rlp_ext, args=(True,), daemon=True
+        ).start()
 
 
 try:

@@ -74,6 +74,17 @@ def load_library() -> Optional[ctypes.CDLL]:
         return _lib
 
 
+def rlp_ext_is_fresh() -> bool:
+    """True when the compiled RLP extension exists and is newer than
+    its source — THE staleness rule, shared by load_rlp_ext and the
+    import-time binding decision in base/rlp.py."""
+    src = os.path.join(_CSRC_EXT, "rlp_ext.c")
+    return os.path.exists(_OUT_EXT) and (
+        not os.path.exists(src)
+        or os.path.getmtime(src) <= os.path.getmtime(_OUT_EXT)
+    )
+
+
 def load_rlp_ext():
     """Compile (if stale) and import the CPython RLP extension module
     (csrc_ext/rlp_ext.c). Returns the module or None — callers fall
@@ -89,9 +100,7 @@ def load_rlp_ext():
             import sysconfig
 
             src = os.path.join(_CSRC_EXT, "rlp_ext.c")
-            if not os.path.exists(_OUT_EXT) or (
-                os.path.getmtime(src) > os.path.getmtime(_OUT_EXT)
-            ):
+            if not rlp_ext_is_fresh():
                 tmp = f"{_OUT_EXT}.{os.getpid()}.tmp"
                 cmd = [
                     "gcc", "-O3", "-shared", "-fPIC",

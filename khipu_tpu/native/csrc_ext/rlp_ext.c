@@ -203,8 +203,10 @@ static PyObject *dec_at(const unsigned char *d, Py_ssize_t len,
       return NULL;
     }
     Py_ssize_t start = pos + 1 + ll;
+    /* n can be near PY_SSIZE_T_MAX: compare by subtraction, never
+       compute start + n (signed overflow is UB) */
+    if (n > len - start) { set_err("truncated string"); return NULL; }
     Py_ssize_t end = start + n;
-    if (end > len) { set_err("truncated string"); return NULL; }
     *end_out = end;
     return PyBytes_FromStringAndSize((const char *)d + start, n);
   }
@@ -230,8 +232,8 @@ static PyObject *dec_at(const unsigned char *d, Py_ssize_t len,
     return NULL;
   }
   Py_ssize_t start = pos + 1 + ll;
+  if (n > len - start) { set_err("truncated list"); return NULL; }
   Py_ssize_t end = start + n;
-  if (end > len) { set_err("truncated list"); return NULL; }
   PyObject *items = dec_list(d, len, start, end, depth);
   if (!items) return NULL;
   *end_out = end;

@@ -141,6 +141,7 @@ class StateSyncer:
         batch_size: int = 100,  # nodes-per-request (application.conf)
         hasher=None,  # batch content-address check; None = host scalar
         checkpoint_every: int = 10,
+        mirror=None,  # DeviceNodeMirror: admits verified state nodes
     ):
         self.storages = storages
         self.state_storage = state_storage
@@ -148,6 +149,11 @@ class StateSyncer:
         self.batch_size = batch_size
         self.hasher = hasher
         self.checkpoint_every = checkpoint_every
+        # device mirror (storage/device_mirror.py): verified nodes are
+        # admitted in the kernel's word-major layout at download time,
+        # so the post-sync whole-snapshot re-verification (config #5)
+        # runs on resident tiles with zero layout work
+        self.mirror = mirror
 
     def _verify(self, hashes: List[bytes], values: List[bytes]) -> List[bool]:
         if self.hasher is None:
@@ -208,6 +214,11 @@ class StateSyncer:
                 self.storages.storage_node_storage.update([], storage_batch)
             if code_batch:
                 self.storages.evmcode_storage.update([], code_batch)
+            if self.mirror is not None:
+                if node_batch:
+                    self.mirror.admit(node_batch)
+                if storage_batch:
+                    self.mirror.admit(storage_batch)
             state.pending.extend(missing)
             if missing and not (node_batch or storage_batch or code_batch):
                 raise RuntimeError(
@@ -216,6 +227,18 @@ class StateSyncer:
             batches_done += 1
             if batches_done % self.checkpoint_every == 0:
                 self.state_storage.put_sync_state(state)
+        if self.mirror is not None:
+            # whole-snapshot re-verification on resident word-major
+            # tiles: one dispatch per size class, zero layout work.
+            # BEFORE purge: a verify failure must leave the resumable
+            # checkpoint intact, not force a full re-download
+            self.mirror.flush()
+            bad = self.mirror.verify()
+            if bad:
+                raise RuntimeError(
+                    f"snapshot verify: {bad} resident nodes failed "
+                    "content-address check"
+                )
         self.state_storage.purge()
         self.storages.app_state.mark_fast_sync_done()
         return state

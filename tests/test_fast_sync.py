@@ -214,3 +214,25 @@ class TestKnownNodes:
         s.update_known_nodes(to_add={"enode://a@1:30303", "enode://b@2:30303"})
         s.update_known_nodes(to_remove={"enode://a@1:30303"})
         assert s.get_known_nodes() == {"enode://b@2:30303"}
+
+    def test_sync_with_device_mirror(self):
+        """Verified nodes admit into the word-major device mirror at
+        download time; completion re-verifies the WHOLE snapshot on
+        resident tiles (config #5 integration)."""
+        from khipu_tpu.storage.device_mirror import DeviceNodeMirror
+
+        src_bc, head = build_source_chain()
+        root = head.header.state_root
+        target = Storages()
+        mirror = DeviceNodeMirror(capacity_rows_per_class=1024)
+        syncer = StateSyncer(
+            target,
+            FastSyncStateStorage(MemoryKeyValueDataSource()),
+            make_fetch(src_bc.storages),
+            mirror=mirror,
+        )
+        state = syncer.start(root)  # raises if snapshot verify fails
+        assert mirror.resident_count > 0
+        assert mirror.verify() == 0
+        # the mirror's resident copy of the root matches the store
+        assert mirror.get(root) == target.account_node_storage.get(root)
