@@ -13,6 +13,9 @@ Methods (all request/response = opaque bytes):
                  (device-batched trie commits), all roots gated.
   BestBlock:     b"" -> rlp([number_be, hash])
   GetStateRoot:  rlp(number_be) -> root (32 bytes) | b"" if unknown
+  GetNodeData:   rlp([hash, ...]) -> rlp([value-or-empty, ...]) — the
+                 served node cache (P6 DistributedNodeStorage role):
+                 remote hosts heal missing trie nodes through it
   Ping:          x -> x
 """
 
@@ -89,6 +92,24 @@ class BridgeServer:
         header = self.blockchain.get_header_by_number(n)
         return header.state_root if header else b""
 
+    def _get_node_data(self, request: bytes, context) -> bytes:
+        """Serve trie nodes / code blobs by hash — the cluster-wide
+        node-cache endpoint (P6: DistributedNodeStorage.scala:13 role,
+        NodeEntity.scala:28's served reads). Request rlp([hash, ...]),
+        response rlp([value-or-empty, ...]) positionally; a remote
+        khipu host points storage/remote.py's fetch at this method and
+        self-heals MPTNodeMissingException across processes."""
+        try:
+            hashes = rlp_decode(request)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad: {e}")
+        storages = self.blockchain.storages
+        out = []
+        for h in hashes[:384]:  # reference caps node batches (conf:100)
+            v = storages.get_node_any(h)
+            out.append(v if v is not None else b"")
+        return rlp_encode(out)
+
     def _ping(self, request: bytes, context) -> bytes:
         return request
 
@@ -104,6 +125,9 @@ class BridgeServer:
             ),
             "GetStateRoot": grpc.unary_unary_rpc_method_handler(
                 self._get_state_root, _identity, _identity
+            ),
+            "GetNodeData": grpc.unary_unary_rpc_method_handler(
+                self._get_node_data, _identity, _identity
             ),
             "Ping": grpc.unary_unary_rpc_method_handler(
                 self._ping, _identity, _identity
@@ -155,6 +179,15 @@ class BridgeClient:
             "GetStateRoot", rlp_encode(to_minimal_bytes(number))
         )
         return out if out else None
+
+    def get_node_data(self, hashes: List[bytes]):
+        """Fetch nodes by hash from the served node cache; returns
+        {hash: value} for the ones the server had. Plugs directly into
+        RemoteReadThroughNodeStorage's fetch callback."""
+        out = rlp_decode(self._call("GetNodeData", rlp_encode(list(hashes))))
+        return {
+            h: v for h, v in zip(hashes, out) if v
+        }
 
     def ping(self, payload: bytes = b"ping") -> bytes:
         return self._call("Ping", payload)

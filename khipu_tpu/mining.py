@@ -31,6 +31,8 @@ class Miner:
         ethash_cache: Optional[EthashCache] = None,
         full_size: Optional[int] = None,
         peer_manager=None,
+        use_dataset: bool = False,
+        dag_dir: Optional[str] = None,
     ):
         self.blockchain = blockchain
         self.config = config
@@ -38,6 +40,17 @@ class Miner:
         self.coinbase = coinbase
         self.cache = ethash_cache  # None = seal-less (dev chains)
         self.full_size = full_size
+        # miner-grade sealing: precompute + file-cache the full DAG
+        # (EthashDataset) so each attempt costs ACCESSES reads instead
+        # of ACCESSES x DATASET_PARENTS cache mixes
+        # (Ethash.scala:65-164,196)
+        self._dataset = None
+        if use_dataset and ethash_cache is not None:
+            from khipu_tpu.consensus.ethash import EthashDataset
+
+            self._dataset = EthashDataset(
+                ethash_cache, full_size, cache_dir=dag_dir
+            )
         # with a peer manager, every sealed block is pushed to peers
         # (BroadcastNewBlocks role, RegularSyncService.scala:306)
         self.peer_manager = peer_manager
@@ -75,10 +88,17 @@ class Miner:
             # re-seal: mine a nonce over the prepared header
             header = block.header
             pow_hash = keccak256(header.encode_without_nonce())
-            nonce, mix = mine(
-                self.cache, pow_hash, header.difficulty,
-                full_size=self.full_size,
-            )
+            if self._dataset is not None:
+                from khipu_tpu.consensus.ethash import mine_full
+
+                nonce, mix = mine_full(
+                    self._dataset, pow_hash, header.difficulty
+                )
+            else:
+                nonce, mix = mine(
+                    self.cache, pow_hash, header.difficulty,
+                    full_size=self.full_size,
+                )
             import dataclasses
 
             sealed_header = dataclasses.replace(

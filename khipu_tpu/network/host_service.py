@@ -87,18 +87,13 @@ class HostService:
         return ETH_OFFSET + RECEIPTS, out
 
     def on_get_node_data(self, body):
-        """Serve trie nodes / code blobs by hash from all three stores
-        (the fast-sync supplier side)."""
+        """Serve trie nodes / code blobs by hash (the fast-sync
+        supplier side); lookup shared with the bridge endpoint
+        (Storages.get_node_any)."""
         s = self.blockchain.storages
         out: List[bytes] = []
         for h in body[:MAX_NODES]:
-            for store in (
-                s.account_node_storage,
-                s.storage_node_storage,
-                s.evmcode_storage,
-            ):
-                v = store.get(h)
-                if v is not None:
-                    out.append(v)
-                    break
+            v = s.get_node_any(h)
+            if v is not None:
+                out.append(v)
         return ETH_OFFSET + NODE_DATA, out

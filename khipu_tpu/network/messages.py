@@ -166,6 +166,16 @@ def decode_transactions(body) -> List[SignedTransaction]:
     return [SignedTransaction.decode(rlp_encode(item)) for item in body]
 
 
+def encode_new_block_hashes(pairs: List[Tuple[bytes, int]]):
+    """NewBlockHashes (PV62.scala:16): [[hash, number], ...] — the
+    lightweight announce sent to peers that don't get the full block."""
+    return [[h, to_minimal_bytes(n)] for h, n in pairs]
+
+
+def decode_new_block_hashes(body) -> List[Tuple[bytes, int]]:
+    return [(item[0], from_bytes(item[1])) for item in body]
+
+
 def encode_new_block(block: Block, td: int):
     return [rlp_decode(block.encode()), to_minimal_bytes(td)]
 

@@ -105,6 +105,22 @@ class Storages:
         for s in self._node_storages:
             s.clear_unconfirmed()
 
+    def get_node_any(self, h: bytes):
+        """One node/code lookup across the three content-addressed
+        stores — THE serving-side resolution, shared by the devp2p
+        GetNodeData handler (network/host_service.py) and the gRPC
+        bridge's served node cache (bridge.py) so the two endpoints
+        cannot drift."""
+        for store in (
+            self.account_node_storage,
+            self.storage_node_storage,
+            self.evmcode_storage,
+        ):
+            v = store.get(h)
+            if v is not None:
+                return v
+        return None
+
     def _all_sources(self):
         for s in self._node_storages:
             yield s.source

@@ -33,12 +33,18 @@ from khipu_tpu.network.messages import (
     GET_BLOCK_HEADERS,
     GET_NODE_DATA,
     NEW_BLOCK,
+    NEW_BLOCK_HASHES,
     NODE_DATA,
+    TRANSACTIONS,
     GetBlockHeaders,
     decode_bodies,
     decode_headers,
     decode_new_block,
+    decode_new_block_hashes,
+    decode_transactions,
     encode_new_block,
+    encode_new_block_hashes,
+    encode_transactions,
 )
 from khipu_tpu.network.peer import Peer, PeerError, PeerManager
 from khipu_tpu.sync.replay import ReplayDriver
@@ -63,6 +69,7 @@ class RegularSyncService:
         request_timeout: float = 5.0,
         log: Optional[Callable[[str], None]] = None,
         device_commit: bool = False,
+        txpool=None,
     ):
         self.blockchain = blockchain
         self.config = config
@@ -79,6 +86,14 @@ class RegularSyncService:
         self.imported = 0
         self.reorgs = 0
         self.healed_nodes = 0
+        # pending-tx pool: every successful import removes the block's
+        # txs (RegularSyncService.scala:419); gossiped txs land here
+        self.txpool = txpool
+        # NewBlockHashes announces, drained by sync_once (fetching from
+        # inside the announcing peer's reader thread would deadlock on
+        # its own reply)
+        self._announced: List[tuple] = []
+        self._announce_lock = threading.Lock()
 
     # ------------------------------------------------------------ fetches
 
@@ -251,7 +266,11 @@ class RegularSyncService:
         # response decide (RegularSyncService.ResumeRegularSyncTask);
         # TD only picks the peer and judges branches.
         try:
-            return self._sync_round(peer, our_best, our_td)
+            # announce fetches share the round's PeerError handling: a
+            # peer that times out answering its own announce gets
+            # demoted, it must not kill the sync loop
+            announced = self._drain_announces(peer)
+            return announced + self._sync_round(peer, our_best, our_td)
         except PeerError as e:
             # wire/protocol failure (disconnect, timeout, mismatched
             # body, garbage headers): demote the peer; the loop carries
@@ -352,6 +371,8 @@ class RegularSyncService:
                         f"block {block.header.number} kept failing "
                         "after heals"
                     )
+                if self.txpool is not None:
+                    self.txpool.remove_mined(block.body.transactions)
                 imported += 1
                 self.imported += 1
         if imported:
@@ -375,12 +396,68 @@ class RegularSyncService:
     # ------------------------------------------------------ propagation
 
     def install_new_block_handler(self) -> None:
-        """Import peer-pushed NewBlock messages (the push path;
-        handleNewBlockMsgs role). Pushed blocks that don't attach to our
-        tip just wait for the next pull round to resolve the branch."""
-        self.manager.handlers[ETH_OFFSET + NEW_BLOCK] = self._on_new_block
+        """Install the gossip consumers: peer-pushed NewBlock imports
+        (handleNewBlockMsgs role), NewBlockHashes announces (queued —
+        sync_once fetches them; fetching on the announcer's reader
+        thread would deadlock on its own reply), and pending-tx gossip
+        into the pool (SignedTransactions, CommonMessages.scala)."""
+        installs = {
+            ETH_OFFSET + NEW_BLOCK: self._on_new_block,
+            ETH_OFFSET + NEW_BLOCK_HASHES: self._on_new_block_hashes,
+            ETH_OFFSET + TRANSACTIONS: self._on_transactions,
+        }
+        self.manager.handlers.update(installs)
         for peer in self.manager.peers:
-            peer.handlers[ETH_OFFSET + NEW_BLOCK] = self._on_new_block
+            peer.handlers.update(installs)
+
+    def _on_transactions(self, body) -> None:
+        if self.txpool is None:
+            return None
+        try:
+            txs = decode_transactions(body)
+        except Exception:
+            return None
+        from khipu_tpu.domain.transaction import recover_senders
+
+        recover_senders(txs)
+        for stx in txs:
+            if stx.sender is not None:
+                self.txpool.add(stx)
+        return None
+
+    def _on_new_block_hashes(self, body) -> None:
+        try:
+            pairs = decode_new_block_hashes(body)
+        except Exception:
+            return None
+        with self._announce_lock:
+            self._announced.extend(pairs)
+            del self._announced[:-64]  # bounded backlog
+        return None
+
+    def _drain_announces(self, peer: Peer) -> int:
+        """Fetch + import announced blocks we don't have yet (PV62
+        NewBlockHashes consumer). Runs on the pull thread."""
+        with self._announce_lock:
+            pairs, self._announced = self._announced, []
+        before = self.imported
+        for block_hash, number in pairs:
+            if self.blockchain.get_header_by_hash(block_hash) is not None:
+                continue
+            if number != self.blockchain.best_block_number + 1:
+                continue  # the pull round handles gaps/branches
+            headers = self._request_headers(peer, number, 1)
+            if not headers or headers[0].hash != block_hash:
+                continue
+            blocks = self._fetch_blocks(peer, headers)
+            if not self._import_lock.acquire(blocking=False):
+                break
+            try:
+                for block in blocks:
+                    self._on_new_block_locked(block)
+            finally:
+                self._import_lock.release()
+        return self.imported - before
 
     def _on_new_block(self, body) -> None:
         # Runs on the pushing peer's reader thread: chain checks and the
@@ -412,6 +489,8 @@ class RegularSyncService:
         try:
             self._driver._execute_and_insert(block, _NullStats())
             self.imported += 1
+            if self.txpool is not None:
+                self.txpool.remove_mined(block.body.transactions)
             self.log(f"imported pushed block #{block.header.number}")
         except Exception as e:  # invalid push: pull loop decides
             self.log(f"pushed block rejected: {e}")
@@ -432,6 +511,75 @@ def broadcast_new_block(manager: PeerManager, block: Block, td: int) -> int:
         except Exception:
             pass
     return sent
+
+
+def propagate_block(manager: PeerManager, block: Block, td: int) -> int:
+    """Standard eth propagation split: the FULL block goes to
+    ceil(sqrt(peers)) peers, the lightweight NewBlockHashes announce to
+    the rest (they fetch on demand) — bandwidth-bounded flood, the
+    shape the reference's BroadcastNewBlocks + NewBlockHashes pair
+    implements. Returns peers reached."""
+    import math
+
+    peers = [p for p in list(manager.peers) if p.alive]
+    if not peers:
+        return 0
+    n_full = max(1, math.isqrt(len(peers)))
+    full_payload = encode_new_block(block, td)
+    hash_payload = encode_new_block_hashes(
+        [(block.hash, block.header.number)]
+    )
+    sent = 0
+    for i, peer in enumerate(peers):
+        try:
+            if i < n_full:
+                peer.send(ETH_OFFSET + NEW_BLOCK, full_payload)
+            else:
+                peer.send(ETH_OFFSET + NEW_BLOCK_HASHES, hash_payload)
+            sent += 1
+        except Exception:
+            pass
+    return sent
+
+
+def broadcast_transactions(manager: PeerManager, stxs) -> int:
+    """Gossip pending transactions to live peers (SignedTransactions,
+    CommonMessages.scala; the reference's PendingTransactionsService
+    pubsub role). A per-peer known-tx set (the reference's
+    knownTransactions) suppresses re-sends: once T has been sent to P,
+    later gossip ticks skip it, so a tx crosses each link a bounded
+    number of times instead of re-flooding the mesh every hop."""
+    stxs = list(stxs)
+    if not stxs:
+        return 0
+    sent = 0
+    for peer in list(manager.peers):
+        if not peer.alive:
+            continue
+        known = peer.__dict__.setdefault("known_txs", set())
+        fresh = [s for s in stxs if s.hash not in known]
+        if not fresh:
+            continue
+        try:
+            peer.send(ETH_OFFSET + TRANSACTIONS, encode_transactions(fresh))
+            known.update(s.hash for s in fresh)
+            if len(known) > 16384:  # bounded memory per peer
+                peer.known_txs = set(list(known)[8192:])
+            sent += 1
+        except Exception:
+            pass
+    return sent
+
+
+def gossip_pending(manager: PeerManager, pool, cursor: int) -> int:
+    """Broadcast txs that arrived in the pool since ``cursor`` (the
+    pool's arrival journal); returns the new cursor. The node main loop
+    calls this each tick — local submissions (eth_sendRawTransaction /
+    personal_sendTransaction) and peer-gossiped txs both propagate."""
+    hashes, new_cursor = pool.arrivals_since(cursor)
+    stxs = [pool.get(h) for h in hashes]
+    broadcast_transactions(manager, [s for s in stxs if s is not None])
+    return new_cursor
 
 
 class _NullStats:

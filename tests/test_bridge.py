@@ -102,3 +102,84 @@ class TestBridge:
     def test_unknown_root_empty(self, bridge):
         client, _ = bridge
         assert client.get_state_root(99) is None
+
+
+SERVER_SCRIPT = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from khipu_tpu.config import fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.base.crypto.secp256k1 import privkey_to_pubkey, pubkey_to_address
+from khipu_tpu.bridge import BridgeServer
+
+CFG = fixture_config(chain_id=1)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(3)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ALLOC = {{a: 10**21 for a in ADDRS}}
+bc = Blockchain(Storages(), CFG)
+builder = ChainBuilder(bc, CFG, GenesisSpec(alloc=ALLOC))
+for i in range(4):
+    builder.add_block(
+        [sign_transaction(Transaction(i, 10**9, 21000, ADDRS[1], 5),
+                          KEYS[0], chain_id=1)],
+        coinbase=b"\xaa" * 20,
+    )
+server = BridgeServer(bc, CFG)
+port = server.start()
+root = bc.get_header_by_number(4).state_root
+print(f"{{port}} {{root.hex()}}", flush=True)
+sys.stdin.readline()  # parent closes stdin to stop us
+"""
+
+
+class TestServedNodeCache:
+    def test_cross_process_heal(self):
+        """P6 (DistributedNodeStorage role): a SEPARATE PROCESS serves
+        its node cache over the bridge's GetNodeData; this process,
+        with an EMPTY local store, walks the remote state trie through
+        RemoteReadThroughNodeStorage — every node heals across the
+        process boundary, content-address verified."""
+        import os
+        import subprocess
+        import sys
+
+        from khipu_tpu.storage.datasource import MemoryKeyValueDataSource
+        from khipu_tpu.storage.node_storage import NodeStorage
+        from khipu_tpu.storage.remote import RemoteReadThroughNodeStorage
+        from khipu_tpu.trie.mpt import MerklePatriciaTrie
+        from khipu_tpu.domain.account import Account, address_key
+
+        repo = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", SERVER_SCRIPT.format(repo=repo)],
+            stdout=subprocess.PIPE,
+            stdin=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            line = proc.stdout.readline().split()
+            port, root = int(line[0]), bytes.fromhex(line[1])
+            client = BridgeClient(f"127.0.0.1:{port}")
+            local = RemoteReadThroughNodeStorage(
+                NodeStorage(MemoryKeyValueDataSource()),
+                client.get_node_data,
+            )
+            trie = MerklePatriciaTrie(local, root_hash=root)
+            raw = trie.get(address_key(ADDRS[1]))
+            assert raw is not None, "remote account unreadable"
+            acc = Account.decode(raw)
+            assert acc.balance == 10**21 + 4 * 5
+            assert local.healed > 0  # nodes really crossed processes
+            # a second read serves locally (healed nodes persisted)
+            healed_before = local.healed
+            assert trie.get(address_key(ADDRS[1])) == raw
+            assert local.healed == healed_before
+            client.close()
+        finally:
+            proc.stdin.close()
+            proc.wait(timeout=10)

@@ -276,3 +276,38 @@ class TestMoreRpc:
 
         with _p.raises(RpcError):
             svc.eth_getFilterLogs("0x999")
+
+
+def test_miner_full_dataset_seal(tmp_path):
+    """Miner-grade sealing over the precomputed DAG: the sealed block
+    validates on the light (validator) path — the real miner/validator
+    split at a reduced epoch size."""
+    from khipu_tpu.base.crypto.keccak import keccak256
+    from khipu_tpu.consensus.ethash import EthashCache, check_pow
+    from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+    from khipu_tpu.mining import Miner
+    from khipu_tpu.storage.storages import Storages
+    from khipu_tpu.txpool import PendingTransactionsPool
+
+    from khipu_tpu.config import fixture_config
+
+    cfg = fixture_config(chain_id=1)
+    bc = Blockchain(Storages(), cfg)
+    bc.load_genesis(GenesisSpec(alloc={}))
+    cache = EthashCache(0, cache_bytes=1024)
+    full = 64 * 128
+    miner = Miner(
+        bc, cfg, PendingTransactionsPool(), b"\xaa" * 20,
+        ethash_cache=cache, full_size=full,
+        use_dataset=True, dag_dir=str(tmp_path),
+    )
+    block = miner.mine_next()
+    header = block.header
+    assert check_pow(
+        cache,
+        keccak256(header.encode_without_nonce()),
+        header.mix_hash,
+        int.from_bytes(header.nonce, "big"),
+        header.difficulty,
+        full_size=full,
+    )
