@@ -183,11 +183,16 @@ class BridgeClient:
     def get_node_data(self, hashes: List[bytes]):
         """Fetch nodes by hash from the served node cache; returns
         {hash: value} for the ones the server had. Plugs directly into
-        RemoteReadThroughNodeStorage's fetch callback."""
-        out = rlp_decode(self._call("GetNodeData", rlp_encode(list(hashes))))
-        return {
-            h: v for h, v in zip(hashes, out) if v
-        }
+        RemoteReadThroughNodeStorage's fetch callback. Chunks at the
+        server's 384-hash cap so oversized requests don't silently
+        report the tail as missing."""
+        hashes = list(hashes)
+        result = {}
+        for start in range(0, len(hashes), 384):
+            chunk = hashes[start : start + 384]
+            out = rlp_decode(self._call("GetNodeData", rlp_encode(chunk)))
+            result.update(h_v for h_v in zip(chunk, out) if h_v[1])
+        return result
 
     def ping(self, payload: bytes = b"ping") -> bytes:
         return self._call("Ping", payload)

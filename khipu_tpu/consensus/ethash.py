@@ -303,6 +303,20 @@ def check_pow(
     return int.from_bytes(result, "big") <= (1 << 256) // difficulty
 
 
+def _mine(hashimoto_fn, header_hash: bytes, difficulty: int,
+          start_nonce: int, max_tries: int) -> Tuple[int, bytes]:
+    """One nonce-scan core (Miner.scala:40 role), parametric over the
+    hashimoto path — light and full share the bound semantics."""
+    if difficulty <= 0:
+        raise ValueError("difficulty must be positive")
+    bound = (1 << 256) // difficulty
+    for nonce in range(start_nonce, start_nonce + max_tries):
+        mix, result = hashimoto_fn(header_hash, nonce)
+        if int.from_bytes(result, "big") <= bound:
+            return nonce, mix
+    raise RuntimeError("nonce space exhausted")
+
+
 def mine(
     cache: EthashCache,
     header_hash: bytes,
@@ -311,15 +325,11 @@ def mine(
     full_size: Optional[int] = None,
     max_tries: int = 1 << 20,
 ) -> Tuple[int, bytes]:
-    """Miner.scala:40 role (light): scan nonces until the bound holds."""
-    if difficulty <= 0:
-        raise ValueError("difficulty must be positive")
-    bound = (1 << 256) // difficulty
-    for nonce in range(start_nonce, start_nonce + max_tries):
-        mix, result = hashimoto_light(cache, header_hash, nonce, full_size)
-        if int.from_bytes(result, "big") <= bound:
-            return nonce, mix
-    raise RuntimeError("nonce space exhausted")
+    """Validator-grade scan: items derived from the epoch cache."""
+    return _mine(
+        lambda h, n: hashimoto_light(cache, h, n, full_size),
+        header_hash, difficulty, start_nonce, max_tries,
+    )
 
 
 def mine_full(
@@ -332,11 +342,7 @@ def mine_full(
     """Miner-grade scan over the precomputed DAG (Ethash.scala:65-164
     path): each attempt costs ACCESSES dataset reads instead of
     ACCESSES x DATASET_PARENTS cache mixes."""
-    if difficulty <= 0:
-        raise ValueError("difficulty must be positive")
-    bound = (1 << 256) // difficulty
-    for nonce in range(start_nonce, start_nonce + max_tries):
-        mix, result = hashimoto_full(dataset, header_hash, nonce)
-        if int.from_bytes(result, "big") <= bound:
-            return nonce, mix
-    raise RuntimeError("nonce space exhausted")
+    return _mine(
+        lambda h, n: hashimoto_full(dataset, h, n),
+        header_hash, difficulty, start_nonce, max_tries,
+    )

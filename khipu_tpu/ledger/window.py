@@ -209,30 +209,55 @@ class WindowCommitter:
         resolved_global = self._resolved_global
         to_resolve: Dict[bytes, bytes] = {}
         deps: Dict[bytes, List[bytes]] = {}
+        depth_of: Dict[bytes, int] = {}
+        max_depth = 0
+        # ONE ascending scan does substitution of prior-window hashes,
+        # child detection AND depth: placeholder indices are assigned
+        # at node creation and tries build bottom-up, so a child's
+        # index is always below its parent's — by the time a parent is
+        # scanned, every child's depth is known
         for idx in range(start, end):
             ph = _make_placeholder(idx)
             enc = self._staged.get(ph)
             if enc is None:
                 continue  # e.g. another session's counter range
-            sub = _substitute_bytes(enc, resolved_global)
-            to_resolve[ph] = sub
-        for ph, enc in to_resolve.items():
-            children: List[bytes] = []
             pos = enc.find(_PLACEHOLDER_PREFIX)
+            if pos < 0:
+                to_resolve[ph] = enc
+                deps[ph] = []
+                depth_of[ph] = 1
+                if max_depth < 1:
+                    max_depth = 1
+                continue
+            out = bytearray(enc)
+            children: List[bytes] = []
+            d = 1
             while pos >= 0:
-                child = enc[pos : pos + 32]
-                if child in to_resolve:
-                    children.append(child)
-                elif child in self._staged:
-                    # a session placeholder that is neither this
-                    # window's nor resolved: the previous window was
-                    # never collected — hashing would bake placeholder
-                    # bytes into the node
-                    raise AssertionError(
-                        "seal() before collect() of the previous window"
-                    )
-                pos = enc.find(_PLACEHOLDER_PREFIX, pos + 32)
+                child = bytes(out[pos : pos + 32])
+                real = resolved_global.get(child)
+                if real is not None:
+                    out[pos : pos + 32] = real
+                else:
+                    cd = depth_of.get(child)
+                    if cd is not None:
+                        children.append(child)
+                        if cd >= d:
+                            d = cd + 1
+                    elif child in self._staged:
+                        # a session placeholder that is neither this
+                        # window's nor resolved: the previous window
+                        # was never collected — hashing would bake
+                        # placeholder bytes into the node
+                        raise AssertionError(
+                            "seal() before collect() of the previous "
+                            "window"
+                        )
+                pos = out.find(_PLACEHOLDER_PREFIX, pos + 32)
+            to_resolve[ph] = bytes(out)
             deps[ph] = children
+            depth_of[ph] = d
+            if d > max_depth:
+                max_depth = d
 
         job = WindowJob(self, pending, to_resolve, live)
         job.codes, self._window_codes = self._window_codes, []
@@ -248,6 +273,7 @@ class WindowCommitter:
                 job.fused_job = fused_submit(
                     to_resolve, deps, _PLACEHOLDER_PREFIX,
                     use_jnp=jax.default_backend() != "tpu",
+                    depth=max_depth,
                 )
                 return job
             except FusedUnsupported:

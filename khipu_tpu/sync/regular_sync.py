@@ -403,12 +403,19 @@ class RegularSyncService:
         into the pool (SignedTransactions, CommonMessages.scala)."""
         installs = {
             ETH_OFFSET + NEW_BLOCK: self._on_new_block,
+            # manager-level (future peers): announce without a source —
+            # the drain falls back to the round's best peer
             ETH_OFFSET + NEW_BLOCK_HASHES: self._on_new_block_hashes,
             ETH_OFFSET + TRANSACTIONS: self._on_transactions,
         }
         self.manager.handlers.update(installs)
         for peer in self.manager.peers:
             peer.handlers.update(installs)
+            # per-peer closure: record WHO announced, so the fetch goes
+            # to the peer that actually has the block
+            peer.handlers[ETH_OFFSET + NEW_BLOCK_HASHES] = (
+                lambda body, p=peer: self._on_new_block_hashes(body, p)
+            )
 
     def _on_transactions(self, body) -> None:
         if self.txpool is None:
@@ -425,31 +432,37 @@ class RegularSyncService:
                 self.txpool.add(stx)
         return None
 
-    def _on_new_block_hashes(self, body) -> None:
+    def _on_new_block_hashes(self, body, source: Peer = None) -> None:
         try:
             pairs = decode_new_block_hashes(body)
         except Exception:
             return None
         with self._announce_lock:
-            self._announced.extend(pairs)
+            self._announced.extend(
+                (h, n, source) for h, n in pairs
+            )
             del self._announced[:-64]  # bounded backlog
         return None
 
     def _drain_announces(self, peer: Peer) -> int:
         """Fetch + import announced blocks we don't have yet (PV62
-        NewBlockHashes consumer). Runs on the pull thread."""
+        NewBlockHashes consumer). Runs on the pull thread; fetches from
+        the ANNOUNCING peer when known (it provably has the block —
+        the best-TD peer may not have imported it yet), else from the
+        round's peer."""
         with self._announce_lock:
             pairs, self._announced = self._announced, []
         before = self.imported
-        for block_hash, number in pairs:
+        for block_hash, number, source in pairs:
             if self.blockchain.get_header_by_hash(block_hash) is not None:
                 continue
             if number != self.blockchain.best_block_number + 1:
                 continue  # the pull round handles gaps/branches
-            headers = self._request_headers(peer, number, 1)
+            src = source if source is not None and source.alive else peer
+            headers = self._request_headers(src, number, 1)
             if not headers or headers[0].hash != block_hash:
                 continue
-            blocks = self._fetch_blocks(peer, headers)
+            blocks = self._fetch_blocks(src, headers)
             if not self._import_lock.acquire(blocking=False):
                 break
             try:
@@ -556,15 +569,19 @@ def broadcast_transactions(manager: PeerManager, stxs) -> int:
     for peer in list(manager.peers):
         if not peer.alive:
             continue
-        known = peer.__dict__.setdefault("known_txs", set())
+        # insertion-ordered dict: the trim really drops the OLDEST half
+        known = peer.__dict__.setdefault("known_txs", {})
         fresh = [s for s in stxs if s.hash not in known]
         if not fresh:
             continue
         try:
             peer.send(ETH_OFFSET + TRANSACTIONS, encode_transactions(fresh))
-            known.update(s.hash for s in fresh)
+            for s in fresh:
+                known[s.hash] = None
             if len(known) > 16384:  # bounded memory per peer
-                peer.known_txs = set(list(known)[8192:])
+                drop = len(known) - 8192
+                for h in list(known)[:drop]:
+                    del known[h]
             sent += 1
         except Exception:
             pass

@@ -244,25 +244,37 @@ def fused_submit(
     for nb in class_list:
         rows = classes[nb]
         width = nb * RATE
-        buf = np.zeros((nrows_pad[nb], width), dtype=np.uint8)
+        npad = nrows_pad[nb]
+        # ONE joined buffer + frombuffer instead of a numpy row-
+        # assignment per node (the row loop was the dominant host cost
+        # of seal); the multi-rate pad bits apply as two vector xors
+        zero = bytes(width)
+        parts: List[bytes] = []
+        lens = np.empty(npad, dtype=np.int64)
         subs: List[Tuple[int, int, int]] = []  # (row, off, child_gpos)
         for r, ph in enumerate(rows):
             enc = to_resolve[ph]
-            buf[r, : len(enc)] = np.frombuffer(enc, dtype=np.uint8)
-            buf[r, len(enc)] ^= 0x01  # multi-rate pad (fixed region:
-            buf[r, width - 1] ^= 0x80  # substitution never touches it)
+            parts.append(enc)
+            parts.append(zero[: width - len(enc)])
+            lens[r] = len(enc)
             pos = enc.find(prefix)
             while pos >= 0:
-                child = enc[pos : pos + 32]
-                cp = dpos.get(child)
+                cp = dpos.get(enc[pos : pos + 32])
                 if cp is not None:
                     subs.append((r, pos, cp))
                 pos = enc.find(prefix, pos + 32)
         # padding rows still need valid keccak padding (their digests
         # are discarded, but the kernel hashes them)
-        for r in range(len(rows), nrows_pad[nb]):
-            buf[r, 0] ^= 0x01
-            buf[r, width - 1] ^= 0x80
+        lens[len(rows):] = 0
+        if npad > len(rows):
+            parts.append(zero * (npad - len(rows)))
+        buf = (
+            np.frombuffer(b"".join(parts), dtype=np.uint8)
+            .reshape(npad, width)
+            .copy()
+        )
+        buf[np.arange(npad), lens] ^= 0x01  # multi-rate pad (fixed
+        buf[:, width - 1] ^= 0x80  # region: substitution never touches)
         # coarse floor: windows of similar size must land in the SAME
         # compiled signature (every distinct shape costs a fresh XLA
         # compile on the first window that hits it)
