@@ -262,10 +262,133 @@ static PyObject *py_set_error(PyObject *self, PyObject *cls) {
   Py_RETURN_NONE;
 }
 
+/* -------------------------------------------------- snappy compress
+ *
+ * Greedy Snappy block-format compressor (the devp2p p2p/v5 frame
+ * codec): a 16-bit hash table finds 4-byte matches within a 64 KiB
+ * window; matches emit copy-with-2-byte-offset ops (<= 64 bytes per
+ * op), gaps emit literals. Output is accepted by any spec decoder —
+ * the Python decompress in network/snappy_codec.py round-trips it in
+ * tests. Role parity: the reference links snappy-java (SURVEY §2.10).
+ */
+
+#define SNAPPY_HASH_BITS 14
+#define SNAPPY_HASH_SIZE (1 << SNAPPY_HASH_BITS)
+
+static inline uint32_t snappy_hash(const unsigned char *p) {
+  uint32_t v = (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+               ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+  return (v * 0x1E35A7BDu) >> (32 - SNAPPY_HASH_BITS);
+}
+
+static unsigned char *emit_literal(unsigned char *op,
+                                   const unsigned char *base,
+                                   Py_ssize_t len) {
+  while (len > 0) {
+    Py_ssize_t n = len;
+    if (n > 65536) n = 65536; /* keep extended length <= 2 bytes */
+    if (n <= 60) {
+      *op++ = (unsigned char)((n - 1) << 2);
+    } else if (n <= 256) {
+      *op++ = 60 << 2;
+      *op++ = (unsigned char)(n - 1);
+    } else {
+      *op++ = 61 << 2;
+      *op++ = (unsigned char)((n - 1) & 0xFF);
+      *op++ = (unsigned char)(((n - 1) >> 8) & 0xFF);
+    }
+    memcpy(op, base, n);
+    op += n;
+    base += n;
+    len -= n;
+  }
+  return op;
+}
+
+static unsigned char *emit_copy(unsigned char *op, Py_ssize_t offset,
+                                Py_ssize_t len) {
+  /* copy2: 6-bit (len-1), 16-bit LE offset; split long matches */
+  while (len > 0) {
+    Py_ssize_t n = len;
+    if (n > 64) n = 64;
+    if (n < 4) break; /* never emit a <4-byte copy (tail folds into
+                         the next literal) */
+    *op++ = (unsigned char)(((n - 1) << 2) | 2);
+    *op++ = (unsigned char)(offset & 0xFF);
+    *op++ = (unsigned char)((offset >> 8) & 0xFF);
+    len -= n;
+  }
+  return op;
+}
+
+static PyObject *py_snappy_compress(PyObject *self, PyObject *arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+  const unsigned char *src = (const unsigned char *)view.buf;
+  Py_ssize_t n = view.len;
+  /* worst-case output bound (snappy's MaxCompressedLength formula):
+     greedy emission can EXPAND — e.g. alternating short literal runs
+     (2-3 header bytes each) with 4-byte copies that save only 1 — so
+     the slack must scale with n/6, not per-64KiB */
+  Py_ssize_t cap = 32 + n + n / 6;
+  unsigned char *buf = (unsigned char *)PyMem_Malloc(cap < 16 ? 16 : cap);
+  if (!buf) {
+    PyBuffer_Release(&view);
+    return PyErr_NoMemory();
+  }
+  unsigned char *op = buf;
+  Py_ssize_t v = n;
+  do { /* varint uncompressed length */
+    unsigned char b = (unsigned char)(v & 0x7F);
+    v >>= 7;
+    *op++ = v ? (b | 0x80) : b;
+  } while (v);
+
+  uint16_t table[SNAPPY_HASH_SIZE];
+  memset(table, 0, sizeof(table));
+  /* table stores pos+1 within the current 64 KiB-aligned region, so a
+     zero entry means empty; offsets are validated against the window */
+  Py_ssize_t lit_start = 0;
+  Py_ssize_t i = 0;
+  while (i + 4 <= n) {
+    uint32_t h = snappy_hash(src + i);
+    Py_ssize_t cand = (Py_ssize_t)table[h] - 1 +
+                      (i & ~(Py_ssize_t)0xFFFF);
+    if (cand >= i) cand -= 65536;
+    table[h] = (uint16_t)((i & 0xFFFF) + 1);
+    if (cand >= 0 && cand < i && i - cand <= 65535 &&
+        memcmp(src + cand, src + i, 4) == 0) {
+      /* extend the match */
+      Py_ssize_t len = 4;
+      while (i + len < n && src[cand + len] == src[i + len] &&
+             len < 65536)
+        ++len;
+      op = emit_literal(op, src + lit_start, i - lit_start);
+      /* emit_copy splits at 64 and refuses a <4-byte tail — compute
+         the coverable length so the tail folds into the next literal */
+      Py_ssize_t covered = len - (len % 64);
+      Py_ssize_t tail = len % 64;
+      if (tail >= 4) covered += tail;
+      op = emit_copy(op, i - cand, covered);
+      i += covered;
+      lit_start = i;
+      continue;
+    }
+    ++i;
+  }
+  op = emit_literal(op, src + lit_start, n - lit_start);
+  PyObject *out = PyBytes_FromStringAndSize((const char *)buf, op - buf);
+  PyMem_Free(buf);
+  PyBuffer_Release(&view);
+  return out;
+}
+
 static PyMethodDef methods[] = {
     {"encode", py_encode, METH_O, "RLP-encode bytes / nested lists."},
     {"decode", py_decode, METH_O, "RLP-decode one item (strict)."},
     {"_set_error", py_set_error, METH_O, "Install the error class."},
+    {"snappy_compress", py_snappy_compress, METH_O,
+     "Greedy Snappy block-format compression."},
     {NULL, NULL, 0, NULL},
 };
 

@@ -305,3 +305,60 @@ class TestDiscovery:
         assert all(len(b) <= K_BUCKET for b in table.buckets)
         closest = table.closest(keccak256(PUB_A), k=5)
         assert len(closest) == 5
+
+
+class TestSnappyCompressor:
+    """The C greedy compressor (rlp_ext.snappy_compress) must round-trip
+    through our spec decompressor and actually compress; the all-literal
+    fallback stays valid."""
+
+    def test_roundtrip_and_ratio(self):
+        import random
+
+        from khipu_tpu.network.snappy_codec import (
+            _compress_literal,
+            compress,
+            decompress,
+        )
+
+        rng = random.Random(9)
+        cases = [
+            b"", b"a", b"ab" * 3, b"x" * 100, b"hello world " * 500,
+            rng.randbytes(1000),
+            bytes(70000),
+            (b"hdr" + bytes(40)) * 2000,
+            rng.randbytes(200) * 300,
+        ]
+        for c in cases:
+            assert decompress(compress(c), max_len=1 << 26) == c
+            assert decompress(_compress_literal(c), max_len=1 << 26) == c
+        for _ in range(100):
+            blob = bytes(
+                rng.choice(b"abcd") for _ in range(rng.randint(0, 3000))
+            )
+            assert decompress(compress(blob), max_len=1 << 26) == blob
+        big = (b"repetitive-node-payload" + bytes(32)) * 5000
+        z = compress(big)
+        assert decompress(z, max_len=1 << 26) == big
+        from khipu_tpu.native.build import load_rlp_ext
+
+        if load_rlp_ext() is not None:
+            assert len(z) < len(big) // 5, "compressor not compressing"
+
+    def test_expansion_worst_case_no_overflow(self):
+        """Regression: greedy emission can EXPAND (short literal runs +
+        4-byte copies); the C buffer must use the snappy worst-case
+        bound, not a per-64KiB slack — this shape overflowed a 4-bytes-
+        per-region capacity and segfaulted."""
+        import random
+
+        from khipu_tpu.network.snappy_codec import compress, decompress
+
+        rng = random.Random(1)
+        parts = []
+        for i in range(8000):
+            parts.append(rng.randbytes(59))
+            parts.append(i.to_bytes(2, "big"))
+            parts.append(b"MARK")
+        blob = b"".join(parts)
+        assert decompress(compress(blob), max_len=1 << 26) == blob
