@@ -321,6 +321,9 @@ def ecdsa_recover_batch(items) -> list:
     if lib is None or not hasattr(lib, "khipu_ecdsa_recover_batch"):
         out = []
         for msg_hash, recid, r, s in items:
+            if len(msg_hash) != 32:  # same verdict as the native path
+                out.append(None)
+                continue
             try:
                 out.append(ecdsa_recover(msg_hash, recid, r, s))
             except SignatureError:
@@ -335,8 +338,13 @@ def ecdsa_recover_batch(items) -> list:
     rec = bytearray(n)
     rs = bytearray(64 * n)
     for i, (msg_hash, recid, r, s) in enumerate(items):
-        if not (0 <= recid <= 3 and 0 < r < N and 0 < s < N):
-            rec[i] = 255  # native rejects out-of-range recids -> None
+        if len(msg_hash) != 32 or not (
+            0 <= recid <= 3 and 0 < r < N and 0 < s < N
+        ):
+            # a non-32-byte hash slice-assigned below would RESIZE the
+            # packed buffer, misaligning every later entry — mark the
+            # entry invalid instead, like the r/s range check
+            rec[i] = 255  # native rejects recid 255 -> None
             continue
         msg[32 * i : 32 * i + 32] = msg_hash
         rec[i] = recid

@@ -16,6 +16,11 @@ Methods (all request/response = opaque bytes):
   GetNodeData:   rlp([hash, ...]) -> rlp([value-or-empty, ...]) — the
                  served node cache (P6 DistributedNodeStorage role):
                  remote hosts heal missing trie nodes through it
+  PutNodeData:   rlp([[hash, value], ...]) -> rlp(admitted_be) — the
+                 write-replication half: a ShardedNodeClient places
+                 each node on every replica of its key so the cluster
+                 keeps serving it when one shard dies. Values are
+                 content-address verified before admission.
   Ping:          x -> x
 """
 
@@ -110,6 +115,29 @@ class BridgeServer:
             out.append(v if v is not None else b"")
         return rlp_encode(out)
 
+    def _put_node_data(self, request: bytes, context) -> bytes:
+        """Admit replicated nodes (cluster write path). Every value is
+        verified against its key before it touches the store — a buggy
+        or hostile replicator cannot poison the served cache. Returns
+        the count actually admitted."""
+        from khipu_tpu.base.crypto.keccak import keccak256
+
+        try:
+            pairs = rlp_decode(request)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad: {e}")
+        storages = self.blockchain.storages
+        admitted = 0
+        for h, v in pairs[:384]:
+            if len(h) == 32 and v and keccak256(v) == h:
+                # same dual admission the heal path uses: the server
+                # cannot know which trie the node belongs to, and
+                # get_node_any serves from either store
+                storages.account_node_storage.put(h, v)
+                storages.storage_node_storage.put(h, v)
+                admitted += 1
+        return rlp_encode(to_minimal_bytes(admitted))
+
     def _ping(self, request: bytes, context) -> bytes:
         return request
 
@@ -128,6 +156,9 @@ class BridgeServer:
             ),
             "GetNodeData": grpc.unary_unary_rpc_method_handler(
                 self._get_node_data, _identity, _identity
+            ),
+            "PutNodeData": grpc.unary_unary_rpc_method_handler(
+                self._put_node_data, _identity, _identity
             ),
             "Ping": grpc.unary_unary_rpc_method_handler(
                 self._ping, _identity, _identity
@@ -193,6 +224,19 @@ class BridgeClient:
             out = rlp_decode(self._call("GetNodeData", rlp_encode(chunk)))
             result.update(h_v for h_v in zip(chunk, out) if h_v[1])
         return result
+
+    def put_node_data(self, nodes) -> int:
+        """Replicate {hash: value} onto this shard; returns the number
+        of nodes the server verified and admitted. Chunks at the
+        server's 384-pair cap."""
+        pairs = [[h, v] for h, v in nodes.items()]
+        admitted = 0
+        for start in range(0, len(pairs), 384):
+            out = self._call(
+                "PutNodeData", rlp_encode(pairs[start : start + 384])
+            )
+            admitted += from_bytes(rlp_decode(out))
+        return admitted
 
     def ping(self, payload: bytes = b"ping") -> bytes:
         return self._call("Ping", payload)

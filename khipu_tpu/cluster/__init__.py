@@ -1,0 +1,28 @@
+"""Sharded distributed node-cache cluster (P6 scaled out).
+
+Parity: khipu-eth/.../storage/DistributedNodeStorage.scala:13-57 and
+NodeEntity.scala:28-50 — the reference spreads its MPT node cache
+across an Akka cluster by hash shard with automatic failover. Here the
+shards are gRPC bridge endpoints (bridge.py GetNodeData/PutNodeData)
+and the Akka cluster-sharding machinery becomes an explicit consistent
+-hash ring (ring.py), a replica-failover read client (client.py) and a
+health/membership prober (health.py) — the same shape as a sharded
+parameter-server tier: deterministic placement, bounded retry,
+circuit breakers, and per-shard observability.
+"""
+
+from khipu_tpu.cluster.ring import HashRing
+from khipu_tpu.cluster.client import (
+    CircuitBreaker,
+    ShardedNodeClient,
+    ShardMetrics,
+)
+from khipu_tpu.cluster.health import HealthMonitor
+
+__all__ = [
+    "HashRing",
+    "CircuitBreaker",
+    "ShardedNodeClient",
+    "ShardMetrics",
+    "HealthMonitor",
+]

@@ -1,0 +1,354 @@
+"""ShardedNodeClient: replica-failover reads over the bridge shards.
+
+Parity: DistributedNodeStorage.scala:13-57 — the reference resolves a
+node hash to a cluster shard and lets Akka handle delivery, retry and
+failover. Explicit here: the ring picks [primary, replicas...] per
+key, the client walks that order with bounded exponential-backoff
+retries and a per-endpoint circuit breaker (the Akka failure detector
+role), verifies every returned value by content address before
+admitting it, and falls back to a local store callback when the whole
+replica set is down — a read NEVER returns wrong bytes and only
+returns None when no copy is reachable anywhere.
+
+Writes replicate: PutNodeData goes to every replica of each key so a
+loopback cluster stays consistent when one shard is killed mid-run.
+
+The transport is injectable (``channel_factory``); production uses
+bridge.BridgeClient, tests plug fakes with scripted failures.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.cluster.ring import HashRing
+
+# breaker states (CircuitBreaker pattern; Akka failure-detector role)
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class CircuitBreaker:
+    """Per-endpoint breaker: ``failure_threshold`` consecutive failures
+    open it; after ``reset_timeout`` one probe call is let through
+    (half-open) — success closes, failure re-opens the full window."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        reset_timeout: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False  # a half-open probe is in flight
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return CLOSED
+            if self._clock() - self._opened_at >= self.reset_timeout:
+                return HALF_OPEN
+            return OPEN
+
+    def allow(self) -> bool:
+        """May a call go to this endpoint right now? Half-open admits
+        exactly ONE probe until its outcome is recorded."""
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if self._clock() - self._opened_at < self.reset_timeout:
+                return False
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probing = False
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                # re-arm the full window (also on a failed probe)
+                self._opened_at = self._clock()
+
+
+class ShardMetrics:
+    """Per-endpoint counters (NodeEntity.scala:28's served-read stats
+    role), snapshotted into the khipu_metrics RPC."""
+
+    def __init__(self) -> None:
+        self.requests = 0  # RPC calls attempted (incl. retries)
+        self.served = 0  # keys answered with verified bytes
+        self.missing = 0  # keys the shard did not have
+        self.corrupt = 0  # keys whose bytes failed the hash check
+        self.failures = 0  # RPC errors (timeouts, resets, refusals)
+        self.failovers = 0  # key-groups handed to the next replica
+        self.replicated = 0  # keys write-replicated to this shard
+        self.latency_ns = 0  # total RPC wall time
+
+    def snapshot(self, breaker: CircuitBreaker, alive: bool) -> dict:
+        return {
+            "alive": alive,
+            "breakerState": breaker.state,
+            "requests": self.requests,
+            "served": self.served,
+            "missing": self.missing,
+            "corrupt": self.corrupt,
+            "failures": self.failures,
+            "failovers": self.failovers,
+            "replicated": self.replicated,
+            "latencySeconds": round(self.latency_ns / 1e9, 6),
+            "hitRate": round(
+                self.served / max(1, self.served + self.missing), 4
+            ),
+        }
+
+
+class ShardedNodeClient:
+    """NodeDataSource read-through contract over N bridge endpoints.
+
+    ``fetch(hashes) -> {hash: verified bytes}`` plugs directly into
+    RemoteReadThroughNodeStorage, the regular-sync heal path and the
+    fast-sync download pool. ``replicate(nodes)`` is the write side.
+    """
+
+    def __init__(
+        self,
+        endpoints: Sequence[str],
+        replication: int = 2,
+        vnodes: int = 64,
+        local_get: Optional[Callable[[bytes], Optional[bytes]]] = None,
+        max_retries: int = 2,
+        backoff_base: float = 0.05,
+        backoff_max: float = 1.0,
+        breaker_failures: int = 5,
+        breaker_reset: float = 30.0,
+        channel_factory: Optional[Callable[[str], object]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if not endpoints:
+            raise ValueError("cluster needs at least one endpoint")
+        self.ring = HashRing(endpoints, replication, vnodes)
+        self.local_get = local_get
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._clock = clock
+        self._sleep = sleep
+        self._channel_factory = channel_factory or self._grpc_factory
+        self._channels: Dict[str, object] = {}
+        self._channel_lock = threading.Lock()
+        self.breakers: Dict[str, CircuitBreaker] = {
+            ep: CircuitBreaker(breaker_failures, breaker_reset, clock)
+            for ep in endpoints
+        }
+        self.metrics: Dict[str, ShardMetrics] = {
+            ep: ShardMetrics() for ep in endpoints
+        }
+        self.local_fallbacks = 0  # keys served by the local store
+        self.unreachable = 0  # keys no copy could serve
+        self._health = None  # attached by HealthMonitor
+
+    # -------------------------------------------------------- transport
+
+    @staticmethod
+    def _grpc_factory(endpoint: str):
+        from khipu_tpu.bridge import BridgeClient
+
+        return BridgeClient(endpoint)
+
+    def _channel(self, endpoint: str):
+        with self._channel_lock:
+            ch = self._channels.get(endpoint)
+            if ch is None:
+                ch = self._channels[endpoint] = self._channel_factory(
+                    endpoint
+                )
+            return ch
+
+    def _drop_channel(self, endpoint: str) -> None:
+        """Forget a (likely broken) channel so the next call redials."""
+        with self._channel_lock:
+            ch = self._channels.pop(endpoint, None)
+        if ch is not None:
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+    def _call(self, endpoint: str, op: Callable[[object], object]):
+        """One guarded RPC with bounded retry + expo backoff + jitter.
+        Raises the last error after ``max_retries`` extra attempts."""
+        breaker = self.breakers[endpoint]
+        m = self.metrics[endpoint]
+        last: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            if not breaker.allow():
+                raise ShardUnavailable(f"{endpoint}: breaker open")
+            m.requests += 1
+            t0 = self._clock()
+            try:
+                out = op(self._channel(endpoint))
+            except Exception as e:  # grpc.RpcError or fake failures
+                m.latency_ns += int((self._clock() - t0) * 1e9)
+                m.failures += 1
+                breaker.record_failure()
+                self._drop_channel(endpoint)
+                last = e
+                if attempt < self.max_retries:
+                    delay = min(
+                        self.backoff_max,
+                        self.backoff_base * (2**attempt),
+                    )
+                    self._sleep(delay * (0.5 + random.random() / 2))
+                continue
+            m.latency_ns += int((self._clock() - t0) * 1e9)
+            breaker.record_success()
+            return out
+        raise last  # type: ignore[misc]
+
+    # ------------------------------------------------------------ reads
+
+    def fetch(self, hashes: List[bytes]) -> Dict[bytes, bytes]:
+        """Read-through fetch: {hash: value} for every hash some healthy
+        copy holds, every value content-address verified. Missing keys
+        are simply absent — the caller's miss semantics apply."""
+        remaining = list(dict.fromkeys(bytes(h) for h in hashes))
+        result: Dict[bytes, bytes] = {}
+        # per-request shard selection: group keys by their replica
+        # chain so one RPC serves each shard's share of the batch
+        groups: Dict[tuple, List[bytes]] = {}
+        for h in remaining:
+            groups.setdefault(tuple(self.ring.replicas_for(h)), []).append(h)
+        for chain, keys in groups.items():
+            want = keys
+            for rank, endpoint in enumerate(chain):
+                if not want:
+                    break
+                m = self.metrics[endpoint]
+                if rank > 0:
+                    m.failovers += 1
+                try:
+                    got = self._call(
+                        endpoint,
+                        lambda ch, w=tuple(want): ch.get_node_data(
+                            list(w)
+                        ),
+                    )
+                except Exception:
+                    continue  # next replica
+                still: List[bytes] = []
+                for h in want:
+                    v = got.get(h)
+                    if v is None:
+                        m.missing += 1
+                        still.append(h)
+                    elif keccak256(v) != h:
+                        m.corrupt += 1  # never admit wrong bytes
+                        still.append(h)
+                    else:
+                        m.served += 1
+                        result[h] = v
+                want = still
+            for h in want:  # replica set exhausted: local fallback
+                v = self.local_get(h) if self.local_get else None
+                if v is not None and keccak256(v) == h:
+                    self.local_fallbacks += 1
+                    result[h] = v
+                else:
+                    self.unreachable += 1
+        return result
+
+    # ----------------------------------------------------------- writes
+
+    def replicate(self, nodes: Mapping[bytes, bytes]) -> int:
+        """Write-replicate nodes to every replica of each key; returns
+        the number of (key, endpoint) placements that succeeded. A dead
+        replica is skipped (its breaker records the failure) — the
+        read path's failover covers the gap until it heals."""
+        per_endpoint: Dict[str, Dict[bytes, bytes]] = {}
+        for h, v in nodes.items():
+            for endpoint in self.ring.replicas_for(bytes(h)):
+                per_endpoint.setdefault(endpoint, {})[bytes(h)] = bytes(v)
+        placed = 0
+        for endpoint, batch in per_endpoint.items():
+            try:
+                self._call(
+                    endpoint,
+                    lambda ch, b=batch: ch.put_node_data(b),
+                )
+            except Exception:
+                continue
+            self.metrics[endpoint].replicated += len(batch)
+            placed += len(batch)
+        return placed
+
+    # ----------------------------------------------------- membership
+
+    def mark_dead(self, endpoint: str) -> None:
+        """Health verdict: take the endpoint out of placement. In-flight
+        reads keep their (old-snapshot) replica chains — they fail over
+        normally — new reads stop selecting it."""
+        self.ring.remove(endpoint)
+        self._drop_channel(endpoint)
+
+    def mark_alive(self, endpoint: str) -> None:
+        if endpoint in self.metrics:
+            self.ring.add(endpoint)
+
+    def ping(self, endpoint: str) -> bool:
+        """Health probe primitive (bypasses retries: one shot)."""
+        try:
+            ch = self._channel(endpoint)
+            ch.ping(b"hb")
+        except Exception:
+            self._drop_channel(endpoint)
+            return False
+        return True
+
+    # ------------------------------------------------------ observability
+
+    def metrics_snapshot(self) -> dict:
+        """Everything khipu_metrics surfaces about the cluster."""
+        alive = set(self.ring.members)
+        return {
+            "replication": self.ring.replication,
+            "members": list(self.ring.members),
+            "localFallbacks": self.local_fallbacks,
+            "unreachable": self.unreachable,
+            "shards": {
+                ep: m.snapshot(self.breakers[ep], ep in alive)
+                for ep, m in self.metrics.items()
+            },
+        }
+
+    def close(self) -> None:
+        with self._channel_lock:
+            channels, self._channels = dict(self._channels), {}
+        for ch in channels.values():
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+
+class ShardUnavailable(Exception):
+    """Raised by _call when the breaker refuses the endpoint."""

@@ -1,0 +1,123 @@
+"""Consistent-hash ring: node-hash -> ordered replica set of endpoints.
+
+Parity: DistributedNodeStorage.scala:13-57 shards the node cache by
+``hash % numberOfShards`` under Akka cluster sharding; a consistent
+ring replaces the modulo so membership changes (a shard dying, a new
+one joining) remap only ~1/N of the keyspace instead of all of it —
+the property every sharded KV / parameter-server tier relies on for
+cheap rebalance.
+
+Each endpoint owns ``vnodes`` points on a 64-bit ring (points are
+keccak-derived, so placement is deterministic across processes — every
+client computes the same owner for a key with zero coordination).
+Lookups walk clockwise from the key's point collecting the first
+``replication`` DISTINCT endpoints: the primary plus failover replicas,
+in deterministic preference order.
+
+Membership changes swap an immutable snapshot under a lock; readers
+never block, so a rebalance cannot drop an in-flight read.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Sequence, Tuple
+
+from khipu_tpu.base.crypto.keccak import keccak256
+
+
+def _point(data: bytes) -> int:
+    """64-bit ring coordinate."""
+    return int.from_bytes(keccak256(data)[:8], "big")
+
+
+class HashRing:
+    """Immutable-snapshot consistent-hash ring with virtual nodes."""
+
+    def __init__(
+        self,
+        endpoints: Sequence[str] = (),
+        replication: int = 2,
+        vnodes: int = 64,
+    ):
+        if replication < 1:
+            raise ValueError("replication must be >= 1")
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self.replication = replication
+        self.vnodes = vnodes
+        self._lock = threading.Lock()
+        # snapshot: (sorted points, endpoint per point, member tuple)
+        self._points: List[int] = []
+        self._owners: List[str] = []
+        self._members: Tuple[str, ...] = ()
+        with self._lock:
+            self._rebuild(tuple(dict.fromkeys(endpoints)))
+
+    # ------------------------------------------------------- membership
+
+    def _rebuild(self, members: Tuple[str, ...]) -> None:
+        """Recompute the snapshot (caller holds the lock). Collisions on
+        the 64-bit ring are vanishingly rare; last writer wins."""
+        pairs: Dict[int, str] = {}
+        for ep in members:
+            for i in range(self.vnodes):
+                pairs[_point(f"{ep}#{i}".encode())] = ep
+        points = sorted(pairs)
+        # one atomic swap: readers see either the old or the new ring
+        self._points, self._owners, self._members = (
+            points,
+            [pairs[p] for p in points],
+            members,
+        )
+
+    def add(self, endpoint: str) -> bool:
+        """Join (or re-join) an endpoint; True if membership changed."""
+        with self._lock:
+            if endpoint in self._members:
+                return False
+            self._rebuild(self._members + (endpoint,))
+            return True
+
+    def remove(self, endpoint: str) -> bool:
+        """Leave the ring; True if membership changed."""
+        with self._lock:
+            if endpoint not in self._members:
+                return False
+            self._rebuild(
+                tuple(m for m in self._members if m != endpoint)
+            )
+            return True
+
+    @property
+    def members(self) -> Tuple[str, ...]:
+        return self._members
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    # ---------------------------------------------------------- lookups
+
+    def replicas_for(self, key: bytes) -> List[str]:
+        """The first ``replication`` distinct endpoints clockwise from
+        the key's point: [primary, replica1, ...]. Fewer when the ring
+        holds fewer members; empty on an empty ring."""
+        points, owners = self._points, self._owners
+        if not points:
+            return []
+        idx = bisect.bisect_right(points, _point(key))
+        out: List[str] = []
+        for i in range(len(points)):
+            ep = owners[(idx + i) % len(points)]
+            if ep not in out:
+                out.append(ep)
+                if len(out) == self.replication:
+                    break
+        return out
+
+    def primary_for(self, key: bytes) -> str:
+        owners = self.replicas_for(key)
+        if not owners:
+            raise LookupError("empty ring")
+        return owners[0]

@@ -117,10 +117,30 @@ class SyncConfig:
 
 
 @dataclass(frozen=True)
+class ClusterConfig:
+    """Sharded node-cache cluster (cluster/ package; P6 scaled out —
+    DistributedNodeStorage.scala:13-57 role). Empty ``endpoints``
+    disables clustering (single-node mode, the default)."""
+
+    endpoints: tuple = ()  # ("host:port", ...) bridge shards
+    replication: int = 2  # copies per key on the ring
+    vnodes: int = 64  # virtual nodes per endpoint
+    max_retries: int = 2  # extra attempts per endpoint
+    backoff_base: float = 0.05  # expo backoff first delay (s)
+    backoff_max: float = 1.0  # backoff ceiling (s)
+    breaker_failures: int = 5  # consecutive failures to open
+    breaker_reset: float = 30.0  # open -> half-open window (s)
+    probe_interval: float = 5.0  # health probe period (s)
+    down_after: int = 2  # missed probes to leave the ring
+    up_after: int = 1  # good probes to re-join
+
+
+@dataclass(frozen=True)
 class KhipuConfig:
     blockchain: BlockchainConfig = field(default_factory=BlockchainConfig)
     db: DbConfig = field(default_factory=DbConfig)
     sync: SyncConfig = field(default_factory=SyncConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
 
 
 def fixture_config(

@@ -190,13 +190,25 @@ class EthashDataset:
             self.data = np.memmap(
                 self.path, dtype="<u4", mode="r"
             ).reshape(n_items, 16)
-            # spot-check one row against the cache derivation: a stale
-            # or corrupt DAG file must not validate blocks
-            probe = n_items // 2
-            if not np.array_equal(
-                self.data[probe], cache.calc_dataset_item(probe)
-            ):
-                self.data = None  # regenerate below
+            # spot-check SEVERAL rows against the cache derivation: a
+            # stale or corrupt DAG file must not validate blocks, and a
+            # single fixed probe misses mid-file corruption. Rows are
+            # pseudo-random but seeded from the epoch seed, so every
+            # reuse of the same file checks the same rows (cheap, and a
+            # regression stays reproducible); first/middle/last anchor
+            # the extremes.
+            rng = np.random.default_rng(
+                int.from_bytes(seed[:8], "big") ^ n_items
+            )
+            probes = {0, n_items // 2, n_items - 1} | {
+                int(i) for i in rng.integers(0, n_items, size=8)
+            }
+            for probe in sorted(probes):
+                if not np.array_equal(
+                    self.data[probe], cache.calc_dataset_item(probe)
+                ):
+                    self.data = None  # regenerate below
+                    break
         else:
             self.data = None
         if self.data is None:

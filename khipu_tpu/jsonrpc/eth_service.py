@@ -56,10 +56,14 @@ class EthService:
         blockchain: Blockchain,
         config: KhipuConfig,
         tx_pool: Optional[PendingTransactionsPool] = None,
+        cluster=None,
     ):
         self.blockchain = blockchain
         self.config = config
         self.tx_pool = tx_pool or PendingTransactionsPool()
+        # sharded node-cache cluster client (cluster/client.py); when
+        # set, khipu_metrics surfaces its per-shard counters
+        self.cluster = cluster
         from khipu_tpu.jsonrpc.filters import FilterManager
 
         # eager: a lazy-init race under concurrent RPC threads could
@@ -478,6 +482,10 @@ class EthService:
                 "readSeconds": round(src.clock.elapsed_ns / 1e9, 6)
                 if hasattr(src, "clock") else None,
             }
+        if self.cluster is not None:
+            # per-shard hit rate / latency / failovers / breaker state
+            # (cluster/client.py ShardMetrics)
+            out["cluster"] = self.cluster.metrics_snapshot()
         return out
 
     # ------------------------------------------------------------ codecs

@@ -16,6 +16,7 @@
 #define MAX_DEPTH 64
 
 static PyObject *rlp_error = NULL; /* set via _set_error */
+static PyObject *enc_hook = NULL;  /* test-only: runs between passes */
 
 static void set_err(const char *msg) {
   PyErr_SetString(rlp_error ? rlp_error : PyExc_ValueError, msg);
@@ -70,8 +71,17 @@ static int enc_size(PyObject *o, Py_ssize_t *out, int depth) {
   return 0;
 }
 
-static char *write_len(char *p, Py_ssize_t n, unsigned char offset) {
+/* The write pass is CLAMPED to the buffer sized by enc_size: a
+ * bytearray resized between the two passes (e.g. by a GC finalizer
+ * running on an allocation inside py_encode) must never let memcpy
+ * run past the output bytes object. Every write site bounds-checks
+ * against `end`; py_encode additionally requires the exact sized
+ * length to be produced, so a shrink is rejected too. */
+
+static char *write_len(char *p, const char *end, Py_ssize_t n,
+                       unsigned char offset) {
   if (n < 56) {
+    if (end - p < 1) { set_err("RLP input resized during encode"); return NULL; }
     *p++ = (char)(offset + n);
     return p;
   }
@@ -79,12 +89,13 @@ static char *write_len(char *p, Py_ssize_t n, unsigned char offset) {
   int lb = 0;
   Py_ssize_t l = n;
   while (l) { tmp[lb++] = (unsigned char)(l & 0xFF); l >>= 8; }
+  if (end - p < 1 + lb) { set_err("RLP input resized during encode"); return NULL; }
   *p++ = (char)(offset + 55 + lb);
   for (int i = lb - 1; i >= 0; --i) *p++ = (char)tmp[i];
   return p;
 }
 
-static char *enc_write(PyObject *o, char *p, int depth) {
+static char *enc_write(PyObject *o, char *p, const char *end, int depth) {
   const char *buf;
   Py_ssize_t n;
   if (PyBytes_CheckExact(o)) {
@@ -103,19 +114,23 @@ static char *enc_write(PyObject *o, char *p, int depth) {
       if (enc_size(c, &s, depth + 1) < 0) return NULL;
       total += s;
     }
-    p = write_len(p, total, 0xC0);
+    p = write_len(p, end, total, 0xC0);
+    if (p == NULL) return NULL;
     for (Py_ssize_t i = 0; i < k; ++i) {
       PyObject *c = is_list ? PyList_GET_ITEM(o, i) : PyTuple_GET_ITEM(o, i);
-      p = enc_write(c, p, depth + 1);
+      p = enc_write(c, p, end, depth + 1);
       if (p == NULL) return NULL;
     }
     return p;
   }
   if (n == 1 && (unsigned char)buf[0] < 0x80) {
+    if (end - p < 1) { set_err("RLP input resized during encode"); return NULL; }
     *p++ = buf[0];
     return p;
   }
-  p = write_len(p, n, 0x80);
+  p = write_len(p, end, n, 0x80);
+  if (p == NULL) return NULL;
+  if (n > end - p) { set_err("RLP input resized during encode"); return NULL; }
   memcpy(p, buf, n);
   return p + n;
 }
@@ -123,11 +138,22 @@ static char *enc_write(PyObject *o, char *p, int depth) {
 static PyObject *py_encode(PyObject *self, PyObject *o) {
   Py_ssize_t size;
   if (enc_size(o, &size, 0) < 0) return NULL;
+  if (enc_hook != NULL) { /* test-only seam for the resize race */
+    PyObject *r = PyObject_CallObject(enc_hook, NULL);
+    if (!r) return NULL;
+    Py_DECREF(r);
+  }
   PyObject *out = PyBytes_FromStringAndSize(NULL, size);
   if (!out) return NULL;
-  char *end = enc_write(o, PyBytes_AS_STRING(out), 0);
+  char *buf = PyBytes_AS_STRING(out);
+  char *end = enc_write(o, buf, buf + size, 0);
   if (end == NULL) {
     Py_DECREF(out);
+    return NULL;
+  }
+  if (end != buf + size) { /* shrank between passes */
+    Py_DECREF(out);
+    set_err("RLP input resized during encode");
     return NULL;
   }
   return out;
@@ -262,6 +288,17 @@ static PyObject *py_set_error(PyObject *self, PyObject *cls) {
   Py_RETURN_NONE;
 }
 
+static PyObject *py_set_encode_hook(PyObject *self, PyObject *cb) {
+  /* Test-only: install a callable invoked between the size and write
+   * passes of encode (None clears). Lets tests exercise the
+   * resized-input guard deterministically. */
+  if (cb == Py_None) cb = NULL;
+  Py_XINCREF(cb);
+  Py_XDECREF(enc_hook);
+  enc_hook = cb;
+  Py_RETURN_NONE;
+}
+
 /* -------------------------------------------------- snappy compress
  *
  * Greedy Snappy block-format compressor (the devp2p p2p/v5 frame
@@ -387,6 +424,8 @@ static PyMethodDef methods[] = {
     {"encode", py_encode, METH_O, "RLP-encode bytes / nested lists."},
     {"decode", py_decode, METH_O, "RLP-decode one item (strict)."},
     {"_set_error", py_set_error, METH_O, "Install the error class."},
+    {"_set_encode_hook", py_set_encode_hook, METH_O,
+     "Test-only: callable run between encode's size and write passes."},
     {"snappy_compress", py_snappy_compress, METH_O,
      "Greedy Snappy block-format compression."},
     {NULL, NULL, 0, NULL},

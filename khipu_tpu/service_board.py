@@ -41,6 +41,8 @@ class ServiceBoard:
         self._discovery = None
         self._regular_sync = None
         self._fast_sync = None
+        self._cluster = None
+        self._cluster_health = None
 
     # ---------------------------------------------------------- node key
 
@@ -77,7 +79,10 @@ class ServiceBoard:
         HTTP endpoint is an operator decision, never a default."""
         from khipu_tpu.jsonrpc import EthService, JsonRpcServer
 
-        service = EthService(self.blockchain, self.config, self.tx_pool)
+        service = EthService(
+            self.blockchain, self.config, self.tx_pool,
+            cluster=self._cluster,
+        )
         extra = ()
         keystore_dir = key_dir or (
             os.path.join(self.config.db.data_dir, "keystore")
@@ -131,6 +136,70 @@ class ServiceBoard:
         HostService(self.blockchain).install(self._peer_manager)
         return self._peer_manager.listen(host, port)
 
+    def start_cluster(self, probe: bool = True):
+        """Join the sharded node-cache cluster (cluster/ package; the
+        P6 DistributedNodeStorage role scaled out): the account and
+        storage node stores become cluster-backed read-throughs —
+        every local miss consults the replica shards before giving up
+        — and the health monitor keeps the ring honest. Requires
+        ``config.cluster.endpoints``."""
+        cc = self.config.cluster
+        if not cc.endpoints:
+            raise RuntimeError("config.cluster.endpoints is empty")
+        from khipu_tpu.cluster import HealthMonitor, ShardedNodeClient
+        from khipu_tpu.storage.remote import RemoteReadThroughNodeStorage
+
+        # the cluster's last-resort fallback reads the LOCAL stores
+        # only — captured before wrapping, so a total-cluster outage
+        # cannot recurse back through the read-through wrappers
+        inners = (
+            self.storages.account_node_storage,
+            self.storages.storage_node_storage,
+            self.storages.evmcode_storage,
+        )
+
+        def local_only(h):
+            for s in inners:
+                v = s.get(h)
+                if v is not None:
+                    return v
+            return None
+
+        self._cluster = ShardedNodeClient(
+            cc.endpoints,
+            replication=cc.replication,
+            vnodes=cc.vnodes,
+            max_retries=cc.max_retries,
+            backoff_base=cc.backoff_base,
+            backoff_max=cc.backoff_max,
+            breaker_failures=cc.breaker_failures,
+            breaker_reset=cc.breaker_reset,
+            local_get=local_only,
+        )
+        self.storages.account_node_storage = (
+            RemoteReadThroughNodeStorage.from_cluster(
+                self.storages.account_node_storage, self._cluster
+            )
+        )
+        self.storages.storage_node_storage = (
+            RemoteReadThroughNodeStorage.from_cluster(
+                self.storages.storage_node_storage, self._cluster
+            )
+        )
+        if probe:
+            self._cluster_health = HealthMonitor(
+                self._cluster,
+                interval=cc.probe_interval,
+                down_after=cc.down_after,
+                up_after=cc.up_after,
+            )
+            self._cluster_health.start()
+        return self._cluster
+
+    @property
+    def cluster(self):
+        return self._cluster
+
     def start_regular_sync(self, **kwargs):
         """Tip-following block import over the peer pool
         (RegularSyncService.scala role); requires start_network."""
@@ -138,6 +207,7 @@ class ServiceBoard:
 
         if self._peer_manager is None:
             raise RuntimeError("start_network first")
+        kwargs.setdefault("cluster", self._cluster)
         self._regular_sync = RegularSyncService(
             self.blockchain, self.config, self._peer_manager, **kwargs
         )
@@ -150,6 +220,7 @@ class ServiceBoard:
 
         if self._peer_manager is None:
             raise RuntimeError("start_network first")
+        kwargs.setdefault("cluster", self._cluster)
         self._fast_sync = FastSyncService(
             self.blockchain, self.config, self._peer_manager, **kwargs
         )
@@ -172,10 +243,16 @@ class ServiceBoard:
         """CoordinatedShutdown (Khipu.scala:58-66): services first,
         storages flushed+closed last."""
         for svc in (self._rpc_server, self._bridge_server,
-                    self._peer_manager, self._discovery):
+                    self._peer_manager, self._discovery,
+                    self._cluster_health):
             if svc is not None:
                 try:
                     svc.stop()
                 except Exception:
                     pass
+        if self._cluster is not None:
+            try:
+                self._cluster.close()
+            except Exception:
+                pass
         self.storages.stop()

@@ -18,13 +18,30 @@ from khipu_tpu.base.crypto.keccak import keccak256
 
 class RemoteReadThroughNodeStorage:
     """Wraps a NodeStorage; on local miss, fetches by hash, verifies
-    kec256(value) == hash, persists locally, serves the read."""
+    kec256(value) == hash, persists locally, serves the read.
+
+    ``replicate_to`` (a cluster.ShardedNodeClient) additionally
+    write-replicates every put onto the key's replica shards, so local
+    commits keep the served cluster cache consistent."""
 
     def __init__(self, inner,
-                 fetch: Callable[[List[bytes]], Mapping[bytes, bytes]]):
+                 fetch: Callable[[List[bytes]], Mapping[bytes, bytes]],
+                 replicate_to=None):
         self.inner = inner
         self.fetch = fetch
+        self.replicate_to = replicate_to
         self.healed = 0  # nodes recovered from remote
+
+    @classmethod
+    def from_cluster(cls, inner, cluster, replicate_writes: bool = False):
+        """Back the read-through by a sharded cluster client
+        (cluster/client.py) — per-key shard selection, replica
+        failover, breakers — instead of a single endpoint."""
+        return cls(
+            inner,
+            cluster.fetch,
+            replicate_to=cluster if replicate_writes else None,
+        )
 
     def get(self, key: bytes) -> Optional[bytes]:
         v = self.inner.get(key)
@@ -42,9 +59,13 @@ class RemoteReadThroughNodeStorage:
 
     def put(self, key: bytes, value: bytes) -> None:
         self.inner.put(key, value)
+        if self.replicate_to is not None:
+            self.replicate_to.replicate({key: value})
 
     def update(self, to_remove, to_upsert) -> None:
         self.inner.update(to_remove, to_upsert)
+        if self.replicate_to is not None and to_upsert:
+            self.replicate_to.replicate(to_upsert)
 
     def __getattr__(self, name):
         return getattr(self.inner, name)

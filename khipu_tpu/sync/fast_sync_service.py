@@ -65,6 +65,7 @@ class PeerFetchPool:
         timeout: float = 5.0,
         max_rounds: int = 5,
         log: Optional[Callable[[str], None]] = None,
+        cluster=None,
     ):
         self.manager = manager
         self.per_request = nodes_per_request
@@ -73,6 +74,11 @@ class PeerFetchPool:
         self.log = log or (lambda s: None)
         self.blacklisted = 0
         self._rr = 0  # rotating start so small fetches still spread
+        # sharded node-cache cluster: consulted before the peer pool —
+        # a shard read is one verified RPC vs. a devp2p round-trip, and
+        # the client's replica failover/breakers absorb dead shards
+        self.cluster = cluster
+        self.cluster_served = 0
 
     def _live_peers(self) -> List[Peer]:
         return [
@@ -86,6 +92,14 @@ class PeerFetchPool:
         its CONTENT hash (NodeData replies carry no correlation)."""
         results: Dict[bytes, bytes] = {}
         pending = list(hashes)
+        if self.cluster is not None and pending:
+            try:
+                got = self.cluster.fetch(pending)
+            except Exception:
+                got = {}
+            results.update(got)  # values verified by the client
+            self.cluster_served += len(got)
+            pending = [h for h in pending if h not in results]
         for _ in range(self.max_rounds):
             if not pending:
                 break
@@ -163,6 +177,7 @@ class FastSyncService:
         manager: PeerManager,
         hasher=None,
         log: Optional[Callable[[str], None]] = None,
+        cluster=None,
     ):
         self.blockchain = blockchain
         self.config = config
@@ -177,6 +192,7 @@ class FastSyncService:
             nodes_per_request=sync.nodes_per_request,
             timeout=sync.peer_request_timeout,
             log=self.log,
+            cluster=cluster,
         )
 
     # -------------------------------------------------------------- pivot

@@ -70,6 +70,7 @@ class RegularSyncService:
         log: Optional[Callable[[str], None]] = None,
         device_commit: bool = False,
         txpool=None,
+        cluster=None,
     ):
         self.blockchain = blockchain
         self.config = config
@@ -94,6 +95,12 @@ class RegularSyncService:
         # its own reply)
         self._announced: List[tuple] = []
         self._announce_lock = threading.Lock()
+        # sharded node-cache cluster (cluster/client.py): when set, the
+        # heal path asks the replica shards BEFORE burning a peer
+        # round-trip — the DistributedNodeStorage read the reference
+        # does first (SURVEY §5.3)
+        self.cluster = cluster
+        self.cluster_healed = 0
 
     # ------------------------------------------------------------ fetches
 
@@ -235,7 +242,23 @@ class RegularSyncService:
         """Fetch one trie node by hash and admit it (content-address
         verified) into the node stores — the read-through self-heal the
         kesque DistributedNodeStorage role performs (storage/remote.py),
-        wired into the live import loop."""
+        wired into the live import loop. The sharded cluster (replica
+        failover + breakers, values pre-verified by the client) is
+        consulted first; the announcing peer is the fallback when no
+        shard holds the node."""
+        if self.cluster is not None:
+            try:
+                got = self.cluster.fetch([node_hash])
+            except Exception:
+                got = {}
+            blob = got.get(node_hash)
+            if blob is not None and keccak256(blob) == node_hash:
+                s = self.blockchain.storages
+                s.account_node_storage.put(node_hash, blob)
+                s.storage_node_storage.put(node_hash, blob)
+                self.healed_nodes += 1
+                self.cluster_healed += 1
+                return
         body = peer.request(
             ETH_OFFSET + GET_NODE_DATA,
             [node_hash],
@@ -453,7 +476,7 @@ class RegularSyncService:
         with self._announce_lock:
             pairs, self._announced = self._announced, []
         before = self.imported
-        for block_hash, number, source in pairs:
+        for idx, (block_hash, number, source) in enumerate(pairs):
             if self.blockchain.get_header_by_hash(block_hash) is not None:
                 continue
             if number != self.blockchain.best_block_number + 1:
@@ -464,6 +487,14 @@ class RegularSyncService:
                 continue
             blocks = self._fetch_blocks(src, headers)
             if not self._import_lock.acquire(blocking=False):
+                # a push import holds the lock: give the unprocessed
+                # tail (this announce included) back to the backlog so
+                # the next round retries it instead of dropping it —
+                # prepended to keep announce order ahead of anything
+                # that arrived meanwhile, same bounded-backlog cap
+                with self._announce_lock:
+                    self._announced[:0] = pairs[idx:]
+                    del self._announced[:-64]
                 break
             try:
                 for block in blocks:

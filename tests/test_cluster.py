@@ -1,0 +1,523 @@
+"""Sharded node-cache cluster (khipu_tpu/cluster/): ring placement,
+replica failover, breakers, health membership, and the 3-shard
+kill-one-shard loopback integration (P6 DistributedNodeStorage role
+scaled out — ISSUE 1 acceptance)."""
+
+import collections
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.cluster import (
+    CircuitBreaker,
+    HashRing,
+    HealthMonitor,
+    ShardedNodeClient,
+)
+from khipu_tpu.cluster.client import CLOSED, HALF_OPEN, OPEN
+
+
+def _key(i: int) -> bytes:
+    return keccak256(i.to_bytes(4, "big"))
+
+
+# --------------------------------------------------------------- ring
+
+
+class TestHashRing:
+    def test_distribution_uniformity_bounds(self):
+        ring = HashRing(["a:1", "b:2", "c:3"], replication=2, vnodes=128)
+        counts = collections.Counter(
+            ring.primary_for(_key(i)) for i in range(6000)
+        )
+        assert set(counts) == {"a:1", "b:2", "c:3"}
+        for ep, n in counts.items():
+            share = n / 6000
+            # 128 vnodes keeps shares near 1/3; wide bounds so the
+            # test pins the property, not the exact hash layout
+            assert 0.15 < share < 0.55, (ep, share)
+
+    def test_replicas_distinct_and_sized(self):
+        ring = HashRing(["a", "b", "c", "d"], replication=3)
+        for i in range(200):
+            reps = ring.replicas_for(_key(i))
+            assert len(reps) == 3
+            assert len(set(reps)) == 3
+
+    def test_replication_capped_by_membership(self):
+        ring = HashRing(["only"], replication=3)
+        assert ring.replicas_for(_key(1)) == ["only"]
+        assert HashRing([], replication=2).replicas_for(_key(1)) == []
+
+    def test_placement_deterministic_across_instances(self):
+        a = HashRing(["x", "y", "z"], replication=2)
+        b = HashRing(["z", "x", "y"], replication=2)  # order-insensitive
+        for i in range(300):
+            assert a.replicas_for(_key(i)) == b.replicas_for(_key(i))
+
+    def test_remove_moves_only_dead_shards_keys(self):
+        ring = HashRing(["a", "b", "c"], replication=1, vnodes=128)
+        before = {_key(i): ring.primary_for(_key(i)) for i in range(800)}
+        ring.remove("b")
+        for k, owner in before.items():
+            if owner != "b":
+                # consistent hashing: surviving owners keep their keys
+                assert ring.primary_for(k) == owner
+            else:
+                assert ring.primary_for(k) in ("a", "c")
+        ring.add("b")
+        for k, owner in before.items():
+            assert ring.primary_for(k) == owner  # rejoin restores
+
+    def test_add_remove_report_change(self):
+        ring = HashRing(["a"], replication=1)
+        assert ring.add("b") is True
+        assert ring.add("b") is False
+        assert ring.remove("b") is True
+        assert ring.remove("b") is False
+
+
+# ------------------------------------------------------------ breaker
+
+
+class TestCircuitBreaker:
+    def test_open_half_open_close_transitions(self):
+        now = [0.0]
+        br = CircuitBreaker(
+            failure_threshold=3, reset_timeout=10.0, clock=lambda: now[0]
+        )
+        assert br.state == CLOSED and br.allow()
+        for _ in range(3):
+            br.record_failure()
+        assert br.state == OPEN
+        assert not br.allow()
+        now[0] = 9.9
+        assert not br.allow()
+        now[0] = 10.1
+        assert br.state == HALF_OPEN
+        assert br.allow()  # exactly one probe
+        assert not br.allow()  # concurrent call still shut out
+        br.record_success()
+        assert br.state == CLOSED and br.allow()
+
+    def test_failed_probe_rearms_full_window(self):
+        now = [0.0]
+        br = CircuitBreaker(
+            failure_threshold=2, reset_timeout=5.0, clock=lambda: now[0]
+        )
+        br.record_failure()
+        br.record_failure()
+        now[0] = 5.5
+        assert br.allow()  # half-open probe
+        br.record_failure()  # probe failed
+        assert br.state == OPEN
+        assert not br.allow()
+        now[0] = 10.4
+        assert not br.allow()  # window restarted at t=5.5
+        now[0] = 10.6
+        # 5.5 + 5.0 = 10.5 -> half-open again
+        assert br.state == HALF_OPEN
+        assert br.allow()
+
+    def test_success_resets_failure_streak(self):
+        br = CircuitBreaker(failure_threshold=3, clock=lambda: 0.0)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == CLOSED  # streak broken, never reached 3
+
+
+# ------------------------------------------- fake-transport client
+
+
+class FakeShard:
+    """In-memory stand-in for BridgeClient with scripted failures."""
+
+    def __init__(self, store=None, fail=False):
+        self.store = dict(store or {})
+        self.fail = fail
+        self.get_calls = 0
+        self.put_calls = 0
+
+    def get_node_data(self, hashes):
+        self.get_calls += 1
+        if self.fail:
+            raise ConnectionError("shard down")
+        return {h: self.store[h] for h in hashes if h in self.store}
+
+    def put_node_data(self, nodes):
+        self.put_calls += 1
+        if self.fail:
+            raise ConnectionError("shard down")
+        self.store.update(nodes)
+        return len(nodes)
+
+    def ping(self, payload=b""):
+        if self.fail:
+            raise ConnectionError("shard down")
+        return payload
+
+    def close(self):
+        pass
+
+
+def make_client(shards, **kwargs):
+    kwargs.setdefault("replication", 2)
+    kwargs.setdefault("max_retries", 1)
+    kwargs.setdefault("sleep", lambda s: None)  # no real backoff waits
+    return ShardedNodeClient(
+        list(shards),
+        channel_factory=lambda ep: shards[ep],
+        **kwargs,
+    )
+
+
+VAL = b"some mpt node rlp bytes"
+KEY = keccak256(VAL)
+
+
+class TestShardedNodeClient:
+    def test_fetch_verified_and_counted(self):
+        shards = {ep: FakeShard({KEY: VAL}) for ep in ("a", "b", "c")}
+        cl = make_client(shards)
+        assert cl.fetch([KEY, KEY]) == {KEY: VAL}  # dedup too
+        prim = cl.ring.replicas_for(KEY)[0]
+        assert cl.metrics[prim].served == 1
+        snap = cl.metrics_snapshot()
+        assert snap["shards"][prim]["hitRate"] == 1.0
+        assert snap["replication"] == 2
+
+    def test_replica_fallback_ordering(self):
+        shards = {ep: FakeShard({KEY: VAL}) for ep in ("a", "b", "c")}
+        cl = make_client(shards)
+        chain = cl.ring.replicas_for(KEY)
+        shards[chain[0]].fail = True  # kill the primary
+        assert cl.fetch([KEY]) == {KEY: VAL}
+        # the PRIMARY was attempted (and failed) before the replica
+        assert cl.metrics[chain[0]].failures > 0
+        assert cl.metrics[chain[1]].served == 1
+        assert cl.metrics[chain[1]].failovers == 1
+
+    def test_corrupt_replica_never_serves_wrong_bytes(self):
+        shards = {ep: FakeShard({KEY: VAL}) for ep in ("a", "b", "c")}
+        cl = make_client(shards)
+        chain = cl.ring.replicas_for(KEY)
+        shards[chain[0]].store[KEY] = b"evil bytes"  # wrong content
+        out = cl.fetch([KEY])
+        assert out == {KEY: VAL}  # healed from the honest replica
+        assert cl.metrics[chain[0]].corrupt == 1
+
+    def test_local_fallback_when_all_replicas_down(self):
+        shards = {ep: FakeShard(fail=True) for ep in ("a", "b")}
+        local = {KEY: VAL}
+        cl = make_client(shards, local_get=local.get)
+        assert cl.fetch([KEY]) == {KEY: VAL}
+        assert cl.local_fallbacks == 1
+
+    def test_unreachable_counted_not_fabricated(self):
+        shards = {ep: FakeShard(fail=True) for ep in ("a", "b")}
+        cl = make_client(shards)
+        assert cl.fetch([KEY]) == {}
+        assert cl.unreachable == 1
+
+    def test_retry_then_success(self):
+        class FlakyShard(FakeShard):
+            def get_node_data(self, hashes):
+                self.get_calls += 1
+                if self.get_calls == 1:
+                    raise ConnectionError("transient")
+                return super().get_node_data(hashes)
+
+        shards = {"a": FlakyShard({KEY: VAL})}
+        cl = make_client(shards, replication=1, max_retries=2)
+        assert cl.fetch([KEY]) == {KEY: VAL}
+        assert cl.metrics["a"].failures == 1
+        assert cl.metrics["a"].served == 1
+
+    def test_breaker_shields_dead_shard(self):
+        shards = {ep: FakeShard(fail=True) for ep in ("a", "b")}
+        local = {KEY: VAL}
+        cl = make_client(
+            shards, local_get=local.get,
+            breaker_failures=2, max_retries=0,
+        )
+        for _ in range(4):
+            cl.fetch([KEY])
+        # after the breaker opened, the dead shard stops being dialed
+        assert shards["a"].get_calls <= 2
+        assert shards["b"].get_calls <= 2
+        assert cl.breakers["a"].state == OPEN
+
+    def test_write_replication_places_on_replica_set(self):
+        shards = {ep: FakeShard() for ep in ("a", "b", "c")}
+        cl = make_client(shards)
+        placed = cl.replicate({KEY: VAL})
+        assert placed == 2  # replication factor
+        holders = [ep for ep, sh in shards.items() if KEY in sh.store]
+        assert sorted(holders) == sorted(cl.ring.replicas_for(KEY))
+
+    def test_replicated_key_survives_primary_death(self):
+        shards = {ep: FakeShard() for ep in ("a", "b", "c")}
+        cl = make_client(shards)
+        cl.replicate({KEY: VAL})
+        chain = cl.ring.replicas_for(KEY)
+        shards[chain[0]].fail = True  # SIGKILL-equivalent on the fake
+        assert cl.fetch([KEY]) == {KEY: VAL}
+
+    def test_mark_dead_rebalances_new_reads(self):
+        shards = {ep: FakeShard({KEY: VAL}) for ep in ("a", "b", "c")}
+        cl = make_client(shards)
+        chain = cl.ring.replicas_for(KEY)
+        cl.mark_dead(chain[0])
+        assert chain[0] not in cl.ring.members
+        new_chain = cl.ring.replicas_for(KEY)
+        assert chain[0] not in new_chain
+        assert cl.fetch([KEY]) == {KEY: VAL}
+        assert shards[chain[0]].get_calls == 0  # never dialed
+        cl.mark_alive(chain[0])
+        assert cl.ring.replicas_for(KEY) == chain
+
+
+# ------------------------------------------------------------- health
+
+
+class TestHealthMonitor:
+    def test_down_and_up_with_hysteresis(self):
+        shards = {ep: FakeShard({KEY: VAL}) for ep in ("a", "b", "c")}
+        cl = make_client(shards)
+        mon = HealthMonitor(cl, down_after=2, up_after=1)
+        shards["b"].fail = True
+        mon.probe_once()
+        assert mon.alive("b")  # one miss is not a verdict
+        mon.probe_once()
+        assert not mon.alive("b")
+        assert "b" not in cl.ring.members
+        assert mon.transitions == 1
+        shards["b"].fail = False
+        mon.probe_once()
+        assert mon.alive("b")
+        assert "b" in cl.ring.members
+        assert mon.transitions == 2
+
+    def test_probe_loop_runs_in_background(self):
+        shards = {"a": FakeShard()}
+        cl = make_client(shards, replication=1)
+        mon = HealthMonitor(cl, interval=0.01)
+        mon.start()
+        try:
+            deadline = time.time() + 2
+            while mon._hits.get("a", 0) == 0 and time.time() < deadline:
+                time.sleep(0.01)
+            assert mon._hits.get("a", 0) > 0
+        finally:
+            mon.stop()
+
+
+# ------------------------------------- read-through + metrics glue
+
+
+class TestReadThroughIntegration:
+    def test_from_cluster_heals_and_replicates(self):
+        from khipu_tpu.storage.datasource import MemoryKeyValueDataSource
+        from khipu_tpu.storage.node_storage import NodeStorage
+        from khipu_tpu.storage.remote import RemoteReadThroughNodeStorage
+
+        shards = {ep: FakeShard({KEY: VAL}) for ep in ("a", "b", "c")}
+        cl = make_client(shards)
+        store = RemoteReadThroughNodeStorage.from_cluster(
+            NodeStorage(MemoryKeyValueDataSource()), cl,
+            replicate_writes=True,
+        )
+        assert store.get(KEY) == VAL  # healed through the cluster
+        assert store.healed == 1
+        other = b"another node"
+        store.put(keccak256(other), other)  # write side replicates
+        holders = [
+            ep for ep, sh in shards.items() if keccak256(other) in sh.store
+        ]
+        assert len(holders) == 2
+
+    def test_khipu_metrics_surfaces_cluster(self):
+        from khipu_tpu.config import fixture_config
+        from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+        from khipu_tpu.jsonrpc.eth_service import EthService
+        from khipu_tpu.storage.storages import Storages
+
+        shards = {ep: FakeShard({KEY: VAL}) for ep in ("a", "b")}
+        cl = make_client(shards)
+        chain = cl.ring.replicas_for(KEY)
+        shards[chain[0]].fail = True
+        cl.fetch([KEY])  # force a failover so the counter moves
+        cfg = fixture_config(chain_id=1)
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec())
+        svc = EthService(bc, cfg, cluster=cl)
+        m = svc.khipu_metrics()
+        assert "cluster" in m
+        shards_m = m["cluster"]["shards"]
+        assert shards_m[chain[1]]["failovers"] == 1
+        assert shards_m[chain[0]]["breakerState"] in (CLOSED, OPEN)
+        assert shards_m[chain[1]]["served"] == 1
+
+
+# --------------------------------------- 3-shard loopback kill test
+
+SHARD_SCRIPT = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from khipu_tpu.config import fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.base.crypto.secp256k1 import privkey_to_pubkey, pubkey_to_address
+from khipu_tpu.bridge import BridgeServer
+
+CFG = fixture_config(chain_id=1)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(3)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ALLOC = {{a: 10**21 for a in ADDRS}}
+bc = Blockchain(Storages(), CFG)
+builder = ChainBuilder(bc, CFG, GenesisSpec(alloc=ALLOC))
+for i in range(4):
+    builder.add_block(
+        [sign_transaction(Transaction(i, 10**9, 21000, ADDRS[1], 5),
+                          KEYS[0], chain_id=1)],
+        coinbase=b"\xaa" * 20,
+    )
+server = BridgeServer(bc, CFG)
+port = server.start()
+root = bc.get_header_by_number(4).state_root
+print(f"{{port}} {{root.hex()}}", flush=True)
+sys.stdin.readline()  # parent closes stdin to stop us
+"""
+
+
+class TestThreeShardKillOne:
+    """ISSUE 1 acceptance: 3 bridge shards over identical populated
+    stores; one SIGKILLed mid-run; reads keep healing via replicas
+    (hash-verified — the client never admits wrong bytes), and the
+    failover counters are visible through khipu_metrics."""
+
+    def _spawn_shards(self, n=3):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", SHARD_SCRIPT.format(repo=repo)],
+                stdout=subprocess.PIPE,
+                stdin=subprocess.PIPE,
+                text=True,
+            )
+            for _ in range(n)
+        ]
+        endpoints, roots = [], []
+        for p in procs:
+            port, root = p.stdout.readline().split()
+            endpoints.append(f"127.0.0.1:{int(port)}")
+            roots.append(bytes.fromhex(root))
+        assert len(set(roots)) == 1, "shards must agree on state"
+        return procs, endpoints, roots[0]
+
+    def test_reads_heal_across_a_shard_kill(self):
+        pytest.importorskip("grpc")
+        from khipu_tpu.base.crypto.secp256k1 import (
+            privkey_to_pubkey,
+            pubkey_to_address,
+        )
+        from khipu_tpu.config import fixture_config
+        from khipu_tpu.domain.account import Account, address_key
+        from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+        from khipu_tpu.jsonrpc.eth_service import EthService
+        from khipu_tpu.storage.datasource import MemoryKeyValueDataSource
+        from khipu_tpu.storage.node_storage import NodeStorage
+        from khipu_tpu.storage.remote import RemoteReadThroughNodeStorage
+        from khipu_tpu.storage.storages import Storages
+        from khipu_tpu.trie.mpt import MerklePatriciaTrie
+
+        keys = [(i + 1).to_bytes(32, "big") for i in range(3)]
+        addrs = [pubkey_to_address(privkey_to_pubkey(k)) for k in keys]
+        procs, endpoints, root = self._spawn_shards(3)
+        killed = None
+        try:
+            client = ShardedNodeClient(
+                endpoints,
+                replication=2,
+                max_retries=1,
+                backoff_base=0.01,
+                breaker_failures=2,
+                breaker_reset=30.0,
+            )
+            mon = HealthMonitor(client, down_after=1)
+
+            def fresh_trie():
+                # empty local store per walk: every node must heal
+                # through the cluster, hash-verified by the client
+                local = RemoteReadThroughNodeStorage.from_cluster(
+                    NodeStorage(MemoryKeyValueDataSource()), client
+                )
+                return local, MerklePatriciaTrie(local, root_hash=root)
+
+            local, trie = fresh_trie()
+            raw = trie.get(address_key(addrs[1]))
+            assert raw is not None
+            assert Account.decode(raw).balance == 10**21 + 4 * 5
+            assert local.healed > 0
+
+            # replicate an out-of-band node, then SIGKILL one of its
+            # replicas mid-run: the write-replicated copy must survive
+            extra = b"replicated-out-of-band-node"
+            extra_key = keccak256(extra)
+            assert client.replicate({extra_key: extra}) == 2
+            victim_ep = client.ring.replicas_for(extra_key)[0]
+            victim = procs[endpoints.index(victim_ep)]
+            victim.kill()  # SIGKILL, no graceful stop
+            victim.wait(timeout=10)
+            killed = victim
+
+            # reads keep healing through surviving replicas
+            local, trie = fresh_trie()
+            raw = trie.get(address_key(addrs[0]))
+            assert raw is not None
+            acc = Account.decode(raw)
+            assert acc.balance == 10**21 - 4 * 5 - 4 * 21000 * 10**9
+            assert acc.nonce == 4
+
+            # the write-replicated node survives its primary's death
+            assert client.fetch([extra_key]) == {extra_key: extra}
+
+            # health probe takes the corpse out of the ring
+            mon.probe_once()
+            assert victim_ep not in client.ring.members
+            local, trie = fresh_trie()
+            assert trie.get(address_key(addrs[2])) is not None
+
+            # failover counters visible through the metrics RPC
+            cfg = fixture_config(chain_id=1)
+            bc = Blockchain(Storages(), cfg)
+            bc.load_genesis(GenesisSpec())
+            m = EthService(bc, cfg, cluster=client).khipu_metrics()
+            shard_m = m["cluster"]["shards"]
+            assert victim_ep in shard_m
+            assert shard_m[victim_ep]["failures"] > 0
+            assert (
+                sum(s["failovers"] for s in shard_m.values()) > 0
+            )
+            assert m["cluster"]["unreachable"] == 0  # zero lost reads
+            total_served = sum(s["served"] for s in shard_m.values())
+            assert total_served >= local.healed
+            client.close()
+        finally:
+            for p in procs:
+                if p is not killed:
+                    try:
+                        p.stdin.close()
+                        p.wait(timeout=10)
+                    except Exception:
+                        p.kill()

@@ -45,3 +45,68 @@ def test_native_batch_matches_singles():
         native.keccak256(m) for m in msgs
     ]
     assert native.keccak256_batch([]) == []
+
+
+# ------------------------------------------------- rlp resize guard
+
+def _rlp_ext():
+    from khipu_tpu.base.rlp import RLPError
+    from khipu_tpu.native.build import load_rlp_ext
+
+    ext = load_rlp_ext()
+    if ext is None:
+        pytest.skip("rlp extension unavailable")
+    ext._set_error(RLPError)
+    return ext
+
+
+class TestRlpEncodeResizeGuard:
+    """rlp_ext.c two-pass encode: a bytearray resized between the
+    size pass and the write pass (GC finalizer / rogue thread) must
+    raise RLPError — never scribble past the output buffer."""
+
+    def test_grow_between_passes_raises(self):
+        from khipu_tpu.base.rlp import RLPError
+
+        ext = _rlp_ext()
+        ba = bytearray(b"x" * 10)
+        ext._set_encode_hook(lambda: ba.extend(b"y" * 90))
+        try:
+            with pytest.raises(RLPError):
+                ext.encode([ba, b"tail"])
+        finally:
+            ext._set_encode_hook(None)
+
+    def test_shrink_between_passes_raises(self):
+        from khipu_tpu.base.rlp import RLPError
+
+        ext = _rlp_ext()
+        ba = bytearray(b"x" * 100)
+        ext._set_encode_hook(lambda: ba.__init__(b"x" * 3))
+        try:
+            with pytest.raises(RLPError):
+                ext.encode([ba, b"tail"])
+        finally:
+            ext._set_encode_hook(None)
+
+    def test_hook_without_resize_is_benign(self):
+        ext = _rlp_ext()
+        ba = bytearray(b"hello rlp")
+        ext._set_encode_hook(lambda: None)
+        try:
+            out = ext.encode([ba, b"tail"])
+        finally:
+            ext._set_encode_hook(None)
+        assert out == ext.encode([ba, b"tail"])  # hook cleared, same bytes
+
+    def test_nested_list_growth_raises(self):
+        from khipu_tpu.base.rlp import RLPError
+
+        ext = _rlp_ext()
+        inner = bytearray(b"ab")
+        ext._set_encode_hook(lambda: inner.extend(b"c" * 60))
+        try:
+            with pytest.raises(RLPError):
+                ext.encode([[inner], [b"x", [inner]]])
+        finally:
+            ext._set_encode_hook(None)

@@ -404,3 +404,47 @@ class TestTxGossipAndAnnounces:
         sync.run(until=lambda: syncer_bc.best_block_number >= 3,
                  max_seconds=20)
         assert syncer_bc.get_hash_by_number(3) == chain[2].hash
+
+
+class TestAnnounceBacklogRequeue:
+    """_drain_announces under _import_lock contention: the unprocessed
+    tail must go BACK to the backlog (it used to be dropped on the
+    floor when a push import held the lock)."""
+
+    def _sync(self):
+        bc = Blockchain(Storages(), CFG)
+        bc.load_genesis(GenesisSpec(alloc=ALLOC))
+        return RegularSyncService(bc, CFG, manager=None)
+
+    def test_lock_contention_requeues_unprocessed_tail(self):
+        import types
+
+        sync = self._sync()
+        genesis = sync.blockchain.get_header_by_number(0)
+        h2, h3 = b"\x02" * 32, b"\x03" * 32
+        pairs = [(genesis.hash, 5, None), (h2, 1, None), (h3, 1, None)]
+        with sync._announce_lock:
+            sync._announced.extend(pairs)
+        sync._request_headers = lambda src, n, c: [
+            types.SimpleNamespace(hash=h2)
+        ]
+        sync._fetch_blocks = lambda src, headers: ["sentinel"]
+        peer = types.SimpleNamespace(alive=True)
+        assert sync._import_lock.acquire(blocking=False)
+        try:
+            sync._drain_announces(peer)
+        finally:
+            sync._import_lock.release()
+        assert sync.imported == 0
+        # the already-known genesis announce is consumed; the announce
+        # that hit the contended lock AND everything after it survive
+        assert sync._announced == pairs[1:]
+
+    def test_uncontended_drain_empties_backlog(self):
+        import types
+
+        sync = self._sync()
+        with sync._announce_lock:
+            sync._announced.append((b"\x09" * 32, 99, None))  # gap
+        sync._drain_announces(types.SimpleNamespace(alive=True))
+        assert sync._announced == []  # gaps are the pull round's job

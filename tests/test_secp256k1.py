@@ -177,3 +177,41 @@ class TestNativeCurveOps:
         assert S._mul_add(None, 0, None, 0) is None
         one_g = S._mul_add(None, 1, None, 0, use_g1=True)
         assert one_g == (S.GX, S.GY)
+
+
+class TestRecoverBatchGuards:
+    def test_bad_hash_length_flagged_not_packed(self):
+        """A non-32-byte msg_hash must yield None for THAT item only —
+        not corrupt the packed buffer layout for its neighbours."""
+        from khipu_tpu.base.crypto.secp256k1 import ecdsa_recover_batch
+
+        pub = privkey_to_pubkey(EIP155_PRIV)
+        addr = pubkey_to_address(pub)
+        msgs = [b"a" * 32, b"short", b"b" * 31, b"c" * 33, b"d" * 32]
+        items = []
+        for m in msgs:
+            if len(m) == 32:
+                recid, r, s = ecdsa_sign(m, EIP155_PRIV)
+                items.append((m, recid, r, s))
+            else:
+                items.append((m, 0, 1, 1))
+        out = ecdsa_recover_batch(items)
+        assert len(out) == len(msgs)
+        for m, got in zip(msgs, out):
+            if len(m) == 32:
+                assert got is not None, m
+                assert pubkey_to_address(got) == addr
+            else:
+                assert got is None, m
+
+    def test_bad_scalars_still_rejected(self):
+        from khipu_tpu.base.crypto.secp256k1 import ecdsa_recover_batch
+
+        h = keccak256(b"x")
+        recid, r, s = ecdsa_sign(h, EIP155_PRIV)
+        out = ecdsa_recover_batch(
+            [(h, recid, r, s), (h, 9, r, s), (h, recid, 0, s),
+             (h, recid, r, N)]
+        )
+        assert out[0] is not None
+        assert out[1] is None and out[2] is None and out[3] is None
