@@ -62,7 +62,8 @@ def _replay_keys(nsenders, seed_base=1):
     return keys, addrs
 
 
-def _replay_fixture(parallel, window, alloc, build_blocks, device_commit):
+def _replay_fixture(parallel, window, alloc, build_blocks, device_commit,
+                    pipeline_depth=2):
     """Shared replay-bench scaffolding: build a fixture chain through the
     ChainBuilder, round-trip through wire RLP (replay must pay sender
     recovery + parse like a real sync), then replay into a fresh chain
@@ -87,6 +88,7 @@ def _replay_fixture(parallel, window, alloc, build_blocks, device_commit):
         sync=SyncConfig(
             parallel_tx=parallel, tx_workers=8,
             commit_window_blocks=window,
+            pipeline_depth=pipeline_depth,
         ),
     )
     builder = ChainBuilder(
@@ -112,10 +114,11 @@ def _replay_fixture(parallel, window, alloc, build_blocks, device_commit):
 
 
 def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
-                 note=None):
+                 note=None, pipeline_depth=2):
     """Configs #1/#4: build a fixture chain, then time a validated
     replay into a fresh chain DB with device trie commits (windowed:
-    one batched device pass per `window` blocks)."""
+    one batched device pass per `window` blocks, up to
+    ``pipeline_depth`` windows sealed-but-uncollected in flight)."""
     from khipu_tpu.domain.transaction import Transaction, sign_transaction
 
     nsenders = min(max(txs_per_block, 2), 64)
@@ -151,7 +154,7 @@ def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
 
     stats = _replay_fixture(
         parallel, window, {a: 10**24 for a in addrs}, build,
-        device_commit=True,
+        device_commit=True, pipeline_depth=pipeline_depth,
     )
     emit(
         metric,
@@ -163,9 +166,11 @@ def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
         ),
         conflicts=stats.conflicts,
         window=window,
+        pipeline_depth=pipeline_depth,
         n_blocks=n_blocks,
         txs_per_block=txs_per_block,
         phases=stats.phase_line(),
+        pipeline_occupancy=round(stats.pipeline_occupancy, 4),
         **({"note": note} if note else {}),
     )
 
@@ -362,6 +367,7 @@ def bench_replay_contended(n_blocks=16, txs_per_block=50, hot_recipients=4,
         device_commit=True,
         native_evm=native_available(),
         phases=stats.phase_line(),
+        pipeline_occupancy=round(stats.pipeline_occupancy, 4),
     )
 
 
@@ -696,6 +702,14 @@ def main() -> None:
     bench_replay(
         32, 50, "replay_parallel_commit_fixture_blocks_per_sec",
         parallel=True, window=8,
+    )
+    # deep-pipeline headline: same parallel-commit shape, smaller
+    # windows but 4 sealed-but-uncollected in flight — measures how
+    # much of collect+save hides behind execution (the occupancy
+    # fraction; docs/window_pipeline.md)
+    bench_replay(
+        32, 50, "replay_pipelined_blocks_per_sec",
+        parallel=True, window=4, pipeline_depth=4,
     )
     bench_replay_contended()
     bench_parallel_scaling()

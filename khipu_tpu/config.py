@@ -111,6 +111,12 @@ class SyncConfig:
     nodes_per_request: int = 50
     peer_request_timeout: float = 5.0
     commit_window_blocks: int = 1  # blocks batched per TPU trie commit
+    # windows sealed-but-uncollected allowed in flight: the driver
+    # seals window N+1 (cross-window refs ride the dispatch as
+    # resolved-input tiles) while a background collector checks roots
+    # and persists window N (docs/window_pipeline.md). 1 = the old
+    # seal/collect lockstep, still off the driver thread
+    pipeline_depth: int = 2
     # opcode-level trace for ONE block number (debug-trace-at;
     # VM.scala:40-57) — that block runs sequentially with a per-op line
     debug_trace_at: Optional[int] = None

@@ -486,6 +486,20 @@ class EthService:
             # per-shard hit rate / latency / failovers / breaker state
             # (cluster/client.py ShardMetrics)
             out["cluster"] = self.cluster.metrics_snapshot()
+        # window-pipeline gauges (sync/replay.PIPELINE_GAUGES): depth,
+        # windows sealed/collected/in-flight, driver stall vs collector
+        # busy seconds, and the occupancy fraction of the last run
+        from khipu_tpu.sync.replay import PIPELINE_GAUGES
+
+        out["pipeline"] = {
+            "depth": PIPELINE_GAUGES["depth"],
+            "inFlight": PIPELINE_GAUGES["in_flight"],
+            "windowsSealed": PIPELINE_GAUGES["windows_sealed"],
+            "windowsCollected": PIPELINE_GAUGES["windows_collected"],
+            "occupancy": PIPELINE_GAUGES["occupancy"],
+            "driverStallSeconds": PIPELINE_GAUGES["driver_stall_s"],
+            "collectorBusySeconds": PIPELINE_GAUGES["collector_busy_s"],
+        }
         return out
 
     # ------------------------------------------------------------ codecs

@@ -26,11 +26,16 @@ class KeyStoreError(Exception):
 
 
 def _aes128_ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
-    from cryptography.hazmat.primitives.ciphers import (
-        Cipher,
-        algorithms,
-        modes,
-    )
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher,
+            algorithms,
+            modes,
+        )
+    except ModuleNotFoundError:
+        from khipu_tpu.base.crypto.aes import ctr_crypt
+
+        return ctr_crypt(key16, iv16, data)
 
     cipher = Cipher(algorithms.AES(key16), modes.CTR(iv16))
     enc = cipher.encryptor()

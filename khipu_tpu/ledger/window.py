@@ -19,6 +19,7 @@ during execution), so windows > 1 require Byzantium+ receipt semantics
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
 from khipu_tpu.base.crypto.keccak import keccak256
@@ -31,6 +32,7 @@ from khipu_tpu.trie.deferred import (
     _is_placeholder,
     _make_placeholder,
     _substitute_bytes,
+    _substitute_many,
     _PLACEHOLDER_PREFIX,
 )
 from khipu_tpu.trie.mpt import EMPTY_TRIE_HASH
@@ -73,6 +75,23 @@ class WindowMismatch(Exception):
         self.number = number
 
 
+class WindowPlaceholderError(Exception):
+    """A live placeholder could not be resolved at collect — it was
+    skipped at seal (the ``enc is None`` counter-range branch: the
+    placeholder belongs to a different session sharing the counter) or
+    its digest never materialized. Raised with the placeholder index so
+    the failure names WHICH node instead of a bare KeyError."""
+
+    def __init__(self, ph: bytes, reason: str):
+        idx = int.from_bytes(ph[len(_PLACEHOLDER_PREFIX):], "big")
+        super().__init__(
+            f"window collect: live placeholder #{idx} {reason} "
+            "(skipped at seal? a foreign session sharing the counter "
+            "range cannot be collected here)"
+        )
+        self.index = idx
+
+
 class WindowCommitter:
     def __init__(self, storages, parent_root: bytes,
                  hasher: Hasher = host_hasher,
@@ -97,6 +116,15 @@ class WindowCommitter:
         # later windows' encodings before packing)
         self._resolved_global: Dict[bytes, bytes] = {}
         self._window_start = 0  # counter value at the last seal
+        # deep pipeline: sealed-but-uncollected windows. A later seal
+        # resolves refs into them DEVICE-TO-DEVICE (resolved-input
+        # tiles): ph -> (job, global digest row) while in flight, and
+        # the FIFO of in-flight jobs (collect must run in seal order —
+        # window N+1's packed encodings still embed window-N
+        # placeholder bytes that only resolve through _resolved_global
+        # once N is collected)
+        self._inflight_rows: Dict[bytes, Tuple["WindowJob", int]] = {}
+        self._inflight_jobs: deque = deque()
 
         self._storage_source = _StagedReadThrough(
             storages.storage_node_storage, self._staged,
@@ -190,9 +218,11 @@ class WindowCommitter:
         continues: later blocks keep reading the sealed window's staged
         nodes and committing into the same namespace.
 
-        Requires every previous window to be collected (their resolved
-        hashes are substituted into this window's encodings, so the
-        packed DAG only spans this window's own placeholders)."""
+        Previous windows need NOT be collected first: refs into an
+        in-flight window ride into this dispatch as resolved-input
+        tiles (their final digests gathered device-to-device from the
+        in-flight job's output — docs/window_pipeline.md), so seals can
+        run ``pipeline_depth`` ahead of collects."""
         start, end = self._window_start, self._counter[0]
         self._window_start = end
         pending, self._pending_blocks = self._pending_blocks, []
@@ -207,9 +237,14 @@ class WindowCommitter:
         self.account_trie._logs = self._logs
 
         resolved_global = self._resolved_global
+        inflight_rows = self._inflight_rows
         to_resolve: Dict[bytes, bytes] = {}
         deps: Dict[bytes, List[bytes]] = {}
         depth_of: Dict[bytes, int] = {}
+        # refs into sealed-but-uncollected windows: ph -> (job, row).
+        # These stay AS placeholder bytes in the packed encodings; the
+        # device substitutes them from the resolved-input tile
+        ext_refs: Dict[bytes, Tuple["WindowJob", int]] = {}
         max_depth = 0
         # ONE ascending scan does substitution of prior-window hashes,
         # child detection AND depth: placeholder indices are assigned
@@ -243,15 +278,30 @@ class WindowCommitter:
                         children.append(child)
                         if cd >= d:
                             d = cd + 1
-                    elif child in self._staged:
-                        # a session placeholder that is neither this
-                        # window's nor resolved: the previous window
-                        # was never collected — hashing would bake
-                        # placeholder bytes into the node
-                        raise AssertionError(
-                            "seal() before collect() of the previous "
-                            "window"
-                        )
+                    else:
+                        src = inflight_rows.get(child)
+                        if src is not None:
+                            ext_refs[child] = src
+                        else:
+                            # the background collector may have
+                            # resolved this window between the first
+                            # resolved_global probe and the in-flight
+                            # probe (it publishes hashes BEFORE
+                            # dropping the in-flight rows) — re-check
+                            real = resolved_global.get(child)
+                            if real is not None:
+                                out[pos : pos + 32] = real
+                            elif child in self._staged:
+                                # neither this window's, nor resolved,
+                                # nor in flight: a foreign session
+                                # sharing the staged namespace —
+                                # hashing would bake placeholder
+                                # bytes into the node
+                                raise AssertionError(
+                                    "seal(): unresolvable placeholder "
+                                    "ref (foreign session sharing the "
+                                    "staged namespace?)"
+                                )
                 pos = out.find(_PLACEHOLDER_PREFIX, pos + 32)
             to_resolve[ph] = bytes(out)
             deps[ph] = children
@@ -270,18 +320,38 @@ class WindowCommitter:
                     fused_submit,
                 )
 
+                ext_arg = self._gather_ext(ext_refs) if ext_refs else None
                 job.fused_job = fused_submit(
                     to_resolve, deps, _PLACEHOLDER_PREFIX,
                     use_jnp=jax.default_backend() != "tpu",
                     depth=max_depth,
+                    ext=ext_arg,
                 )
+                if job.fused_job.dpos:
+                    for ph2, row in job.fused_job.dpos.items():
+                        inflight_rows[ph2] = (job, row)
+                    self._inflight_jobs.append(job)
                 return job
             except FusedUnsupported:
                 pass
-        # host path: level-synchronous hasher loop, resolved eagerly
+        # host path: level-synchronous hasher loop, resolved eagerly.
+        # Cross-window refs seed the mapping from the source job's
+        # digests (a blocking collect of the device output — rare: only
+        # the FusedUnsupported fallback mid-pipeline takes this branch
+        # with ext_refs; the pure host-hasher path resolves eagerly so
+        # its digests are already in _resolved_global at the next seal)
         from khipu_tpu.trie.fused import topo_levels
 
         mapping: Dict[bytes, bytes] = {}
+        for child, (src, _row) in ext_refs.items():
+            real = src.fused_job.collect().get(child)
+            if real is None:
+                real = resolved_global.get(child)
+            if real is None:
+                raise WindowPlaceholderError(
+                    child, "is referenced across windows but has no digest"
+                )
+            mapping[child] = real
         for level in topo_levels(deps):
             encodings = [
                 _substitute_bytes(to_resolve[ph], mapping) for ph in level
@@ -289,12 +359,66 @@ class WindowCommitter:
             digests = self.hasher(encodings)
             mapping.update(zip(level, digests))
         job.mapping = mapping
+        # digests are FINAL here — publish now so the next seal resolves
+        # this window's refs without a barrier (persistence is still
+        # gated by collect's root checks)
+        resolved_global.update(mapping)
         return job
+
+    def _gather_ext(self, ext_refs) -> Tuple[object, Dict[bytes, int]]:
+        """Build the resolved-input tile for ``fused_submit``: gather
+        the referenced rows out of each in-flight job's device digest
+        array (device-to-device, no host round-trip) and concatenate.
+        Returns ``(tile u8[n,32], ph -> tile row)``. The fixpoint
+        program only reads the tile rows AFTER its own queue position,
+        by which time the source dispatch has finished — XLA's program
+        order on one device is the synchronization."""
+        import numpy as np
+
+        groups: Dict[int, Tuple["WindowJob", List[bytes]]] = {}
+        for child, (src, _row) in ext_refs.items():
+            groups.setdefault(id(src), (src, []))[1].append(child)
+        parts = []
+        ext_pos: Dict[bytes, int] = {}
+        nxt = 0
+        for src, childs in groups.values():
+            rows = np.asarray(
+                [src.fused_job.dpos[c] for c in childs], dtype=np.int32
+            )
+            parts.append(src.fused_job.digests[rows])
+            for c in childs:
+                ext_pos[c] = nxt
+                nxt += 1
+        if len(parts) == 1:
+            tile = parts[0]
+        else:
+            import jax.numpy as jnp
+
+            tile = jnp.concatenate(parts, axis=0)
+        return tile, ext_pos
 
     def collect(self, job: "WindowJob") -> List[Tuple[BlockHeader, bytes]]:
         """Wait for a sealed window's digests, CHECK every block root
         against its header, persist its live nodes + codes, and fold the
-        mapping into the session. Returns [(header, real_root)]."""
+        mapping into the session. Returns [(header, real_root)].
+
+        May run on a background collector thread while the driver seals
+        later windows. The step ORDER below is the thread-safety
+        invariant (every mutation is a GIL-atomic dict/deque op):
+        persist nodes BEFORE publishing their hashes in
+        ``_resolved_global``, publish BEFORE pruning ``_staged``, prune
+        BEFORE dropping the in-flight rows — a racing ``seal`` or
+        ``_StagedReadThrough`` reader always finds each node through at
+        least one of the maps."""
+        if job.fused_job is not None and self._inflight_jobs:
+            if (self._inflight_jobs[0] is not job
+                    and job in self._inflight_jobs):
+                # window N+1's encodings still embed window-N
+                # placeholder bytes that only resolve once N publishes
+                raise AssertionError(
+                    "collect() out of FIFO order: an earlier sealed "
+                    "window is still in flight"
+                )
         mapping = job.mapping
         if mapping is None:
             mapping = job.fused_job.collect()
@@ -310,13 +434,36 @@ class WindowCommitter:
             results.append((header, real))
 
         # persist LIVE nodes only (dead intermediates were hashed for the
-        # root checks but nothing references them), routed by session tag
+        # root checks but nothing references them), routed by session
+        # tag. Substitution is ONE vectorized pass over the joined
+        # encodings (numpy prefix scan) instead of a Python scan per
+        # node — collect was 46% of replay wall clock (BENCH_r05).
+        # Cross-window refs resolve through resolved_global: FIFO
+        # collect order guarantees the source window published first.
+        live_phs: List[bytes] = []
+        reals: List[bytes] = []
+        encs: List[bytes] = []
+        for ph in job.live:
+            real = mapping.get(ph) or resolved_global.get(ph)
+            if real is None:
+                raise WindowPlaceholderError(ph, "has no resolved digest")
+            enc = job.to_resolve.get(ph)
+            if enc is None:
+                raise WindowPlaceholderError(ph, "has no packed encoding")
+            live_phs.append(ph)
+            reals.append(real)
+            encs.append(enc)
+
+        def _lookup(ref, _m=mapping, _g=resolved_global):
+            v = _m.get(ref)
+            return v if v is not None else _g.get(ref)
+
+        subbed = _substitute_many(encs, _lookup)
         account_nodes: Dict[bytes, bytes] = {}
         storage_nodes: Dict[bytes, bytes] = {}
-        for ph in job.live:
-            real = mapping[ph]
-            enc = _substitute_bytes(job.to_resolve[ph], mapping)
-            if ph in self._storage_phs:
+        storage_phs = self._storage_phs
+        for ph, real, enc in zip(live_phs, reals, subbed):
+            if ph in storage_phs:
                 storage_nodes[real] = enc
             else:
                 account_nodes[real] = enc
@@ -336,10 +483,17 @@ class WindowCommitter:
         # unreferenced — keeps session memory ~O(open windows), not
         # O(replayed chain)
         staged = self._staged
-        storage_phs = self._storage_phs
         for ph in job.to_resolve:
             staged.pop(ph, None)
             storage_phs.discard(ph)
+        # drop the in-flight registration LAST: a racing seal that
+        # misses these rows re-checks _resolved_global, published above
+        if job.fused_job is not None:
+            inflight = self._inflight_rows
+            for ph in job.fused_job.dpos:
+                inflight.pop(ph, None)
+            if self._inflight_jobs and self._inflight_jobs[0] is job:
+                self._inflight_jobs.popleft()
         return results
 
     # ---------------------------------------------------------- finalize

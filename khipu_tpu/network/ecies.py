@@ -48,11 +48,16 @@ def concat_kdf(z: bytes, length: int) -> bytes:
 
 
 def _aes128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
-    from cryptography.hazmat.primitives.ciphers import (
-        Cipher,
-        algorithms,
-        modes,
-    )
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher,
+            algorithms,
+            modes,
+        )
+    except ModuleNotFoundError:
+        from khipu_tpu.base.crypto.aes import ctr_crypt
+
+        return ctr_crypt(key, iv, data)
 
     enc = Cipher(algorithms.AES(key), modes.CTR(iv)).encryptor()
     return enc.update(data) + enc.finalize()

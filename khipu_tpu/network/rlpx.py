@@ -74,11 +74,16 @@ def _xor(a: bytes, b: bytes) -> bytes:
 
 
 def _aes256_ctr_stream(key: bytes):
-    from cryptography.hazmat.primitives.ciphers import (
-        Cipher,
-        algorithms,
-        modes,
-    )
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher,
+            algorithms,
+            modes,
+        )
+    except ModuleNotFoundError:
+        from khipu_tpu.base.crypto.aes import CtrCipher
+
+        return CtrCipher(key)  # zero IV, same .update surface
 
     return Cipher(
         algorithms.AES(key), modes.CTR(b"\x00" * 16)
@@ -86,11 +91,16 @@ def _aes256_ctr_stream(key: bytes):
 
 
 def _aes256_ecb(key: bytes, block16: bytes) -> bytes:
-    from cryptography.hazmat.primitives.ciphers import (
-        Cipher,
-        algorithms,
-        modes,
-    )
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher,
+            algorithms,
+            modes,
+        )
+    except ModuleNotFoundError:
+        from khipu_tpu.base.crypto.aes import ecb_encrypt_block
+
+        return ecb_encrypt_block(key, block16)
 
     enc = Cipher(algorithms.AES(key), modes.ECB()).encryptor()
     return enc.update(block16) + enc.finalize()

@@ -380,6 +380,23 @@ class RegularSyncService:
                     )
                 ):
                     return 0
+            # bulk catch-up: a full fetched batch on the canonical
+            # chain routes through the PIPELINED windowed replay
+            # (seal/collect overlap, sync/replay.replay_windowed)
+            # instead of block-at-a-time import; anything it didn't
+            # take falls through to the healing per-block path below
+            window = self.config.sync.commit_window_blocks
+            if window > 1 and not is_reorg and len(blocks) >= window:
+                done = self._import_windowed(blocks)
+                if done:
+                    if self.txpool is not None:
+                        for b in blocks[:done]:
+                            self.txpool.remove_mined(
+                                b.body.transactions
+                            )
+                    imported += done
+                    self.imported += done
+                    blocks = blocks[done:]
             for block in blocks:
                 for attempt in range(3):
                     try:
@@ -404,6 +421,36 @@ class RegularSyncService:
                 f"#{self.blockchain.best_block_number}"
             )
         return imported
+
+    def _import_windowed(self, blocks: List[Block]) -> int:
+        """Import a fetched batch through the windowed pipeline;
+        returns how many LEADING blocks were persisted (windows commit
+        front-to-back, so persisted blocks are always a prefix).
+
+        Failure semantics: replay_windowed persists nothing of a window
+        before its root checks pass, so on any fallback the per-block
+        path can redo the remaining blocks safely. A WindowMismatch is
+        BAD PEER DATA (a header whose state root the re-execution
+        refutes) and escalates as PeerError — sync_once demotes the
+        peer; a missing trie node (fast-sync leftover state) or a
+        pre-Byzantium batch simply falls back to the healing loop."""
+        from khipu_tpu.ledger.window import WindowMismatch
+
+        before = self.blockchain.best_block_number
+        try:
+            self._driver.replay_windowed(
+                iter(blocks), self.config.sync.commit_window_blocks
+            )
+        except WindowMismatch as e:
+            raise PeerError(f"windowed import diverged: {e}")
+        except MPTNodeMissingException as e:
+            self.log(
+                f"windowed import missing node {e.hash[:8].hex()}; "
+                "healing per block"
+            )
+        except Exception as e:  # noqa: BLE001
+            self.log(f"windowed import fell back: {e}")
+        return self.blockchain.best_block_number - before
 
     def run(self, until: Callable[[], bool], poll: float = 0.2,
             max_seconds: float = 60.0) -> None:

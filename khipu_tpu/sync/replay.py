@@ -11,7 +11,9 @@ north-star replay metric (blocks/s) is measured here.
 
 from __future__ import annotations
 
+import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, List, Optional
 
@@ -27,6 +29,19 @@ from khipu_tpu.validators.validators import (
     OmmersValidator,
 )
 
+# live window-pipeline gauges served by the khipu_metrics RPC
+# (jsonrpc/eth_service.py). Plain-dict writes are GIL-atomic; the
+# collector thread and the driver both update them in place.
+PIPELINE_GAUGES = {
+    "depth": 0,  # configured pipeline_depth of the last run
+    "in_flight": 0,  # windows sealed but not yet collected
+    "windows_sealed": 0,
+    "windows_collected": 0,
+    "occupancy": 0.0,  # driver/collector overlap fraction, last run
+    "driver_stall_s": 0.0,  # driver seconds blocked on backpressure
+    "collector_busy_s": 0.0,  # background collect+save busy seconds
+}
+
 
 @dataclass
 class ReplayStats:
@@ -38,8 +53,15 @@ class ReplayStats:
     conflicts: int = 0
     # per-phase wall-clock split (seconds): senders / validate / execute
     # / commit / seal / collect / save — the breakdown that names the
-    # next bottleneck instead of guessing it
+    # next bottleneck instead of guessing it. Under the deep pipeline
+    # `collect`/`save` are DRIVER-THREAD STALL (backpressure + drains);
+    # the background collector's busy time lands in `collect_bg` /
+    # `save_bg` (it overlaps execute, so adding it to wall clock would
+    # double-count)
     phases: dict = field(default_factory=dict)
+    # fraction of the collector's busy time that overlapped driver work
+    # (1.0 = collect/save fully hidden behind execution)
+    pipeline_occupancy: float = 0.0
 
     @property
     def blocks_per_s(self) -> float:
@@ -47,6 +69,120 @@ class ReplayStats:
 
     def phase_line(self) -> dict:
         return {k: round(v, 3) for k, v in self.phases.items()}
+
+
+class _WindowCollector:
+    """Bounded background collector: root checks + live-node/code
+    persistence + block saves run HERE while the driver executes the
+    next window's transactions. ``submit`` enqueues one collect+save
+    closure and blocks only while ``depth`` jobs are already queued or
+    running (backpressure); ``drain`` blocks until the pipeline is
+    empty. Jobs run strictly FIFO on one thread — block saves chain
+    total difficulty, and window N+1's encodings resolve through
+    window N's published hashes (ledger/window.collect docstring).
+
+    Failure semantics: the FIRST exception (typically WindowMismatch)
+    aborts the pipeline — queued jobs are dropped WITHOUT persisting
+    anything and the original exception object re-raises on the driver
+    thread at its next submit/drain, so a mismatch still names the
+    failing block number."""
+
+    def __init__(self, depth: int):
+        self.depth = max(1, depth)
+        self.busy_seconds = 0.0
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._active = False
+        self._failure: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="window-collector", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------- driver side
+
+    def submit(self, fn: Callable[[], None]) -> float:
+        """Queue one job; returns driver seconds stalled on
+        backpressure. Re-raises the collector's failure, if any."""
+        t0 = time.perf_counter()
+        with self._cv:
+            while (self._failure is None and not self._closed
+                   and len(self._q) + self._active >= self.depth):
+                self._cv.wait()
+            if self._failure is not None:
+                raise self._failure
+            if self._closed:
+                raise RuntimeError("collector is closed")
+            self._q.append(fn)
+            PIPELINE_GAUGES["windows_sealed"] += 1
+            PIPELINE_GAUGES["in_flight"] = len(self._q) + self._active
+            self._cv.notify_all()
+        return time.perf_counter() - t0
+
+    def drain(self) -> float:
+        """Wait until every queued job has completed; returns driver
+        seconds stalled. Re-raises the collector's failure, if any."""
+        t0 = time.perf_counter()
+        with self._cv:
+            while self._failure is None and (self._q or self._active):
+                self._cv.wait()
+            if self._failure is not None:
+                raise self._failure
+        return time.perf_counter() - t0
+
+    def close(self) -> None:
+        """Stop the worker (after finishing anything queued) and join.
+        Safe to call twice."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=60.0)
+
+    def kill(self) -> None:
+        """Abort: drop queued jobs WITHOUT running them (nothing else
+        persists) and join. The driver calls this when IT failed —
+        windows sealed after the failing block must not be committed."""
+        with self._cv:
+            self._q.clear()
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=60.0)
+
+    # ------------------------------------------------------- worker side
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while (not self._q and not self._closed
+                       and self._failure is None):
+                    self._cv.wait()
+                if self._failure is not None or (
+                    self._closed and not self._q
+                ):
+                    return
+                fn = self._q.popleft()
+                self._active = True
+                PIPELINE_GAUGES["in_flight"] = len(self._q) + 1
+            t0 = time.perf_counter()
+            try:
+                fn()
+            except BaseException as exc:  # surfaces on the driver
+                with self._cv:
+                    self._failure = exc
+                    self._active = False
+                    self._q.clear()  # abort: NOTHING else persists
+                    PIPELINE_GAUGES["in_flight"] = 0
+                    self._cv.notify_all()
+                return
+            dt = time.perf_counter() - t0
+            with self._cv:
+                self.busy_seconds += dt
+                self._active = False
+                PIPELINE_GAUGES["windows_collected"] += 1
+                PIPELINE_GAUGES["in_flight"] = len(self._q)
+                PIPELINE_GAUGES["collector_busy_s"] = self.busy_seconds
+                self._cv.notify_all()
 
 
 class ReplayDriver:
@@ -106,11 +242,12 @@ class ReplayDriver:
         behind host execution (SURVEY §7.4-5; the reference overlaps
         execution with persistence the same way via its actor mailbox,
         RegularSyncService.scala:381). Root checks happen at collect —
-        one window later than the serial path, with identical failure
-        semantics (nothing of a window persists before its roots pass).
+        up to ``pipeline_depth`` windows later than the serial path, on
+        a background collector thread, with identical failure semantics
+        (nothing of a window persists before its roots pass; a
+        WindowMismatch drains the pipeline and re-raises here with the
+        failing block number — docs/window_pipeline.md).
         """
-        from collections import deque
-
         from khipu_tpu.evm.config import for_block
         from khipu_tpu.ledger.window import WindowCommitter
         from khipu_tpu.trie.bulk import host_hasher
@@ -118,7 +255,7 @@ class ReplayDriver:
         stats = ReplayStats()
         ph = stats.phases
         for k in ("senders", "validate", "execute", "commit", "seal",
-                  "collect", "save"):
+                  "collect", "save", "collect_bg", "save_bg"):
             ph[k] = 0.0
         t_start = time.perf_counter()
         hasher = self.hasher or host_hasher
@@ -153,7 +290,9 @@ class ReplayDriver:
             )
 
         committer = make_committer(parent.state_root)
-        in_flight: deque = deque()  # (WindowJob, [(block, result)])
+        depth = max(1, self.config.sync.pipeline_depth)
+        collector = _WindowCollector(depth)
+        PIPELINE_GAUGES["depth"] = depth
         # epoch reset: every N blocks the session committer is rebuilt
         # from the last VALIDATED root, dropping the resolved-
         # placeholder map and all retained refs — with the per-collect
@@ -163,117 +302,143 @@ class ReplayDriver:
         epoch = self.session_epoch_blocks
         blocks_since_reset = 0
 
-        def collect_one():
-            job, results = in_flight.popleft()
-            t0 = time.perf_counter()
-            committer.collect(job)  # raises WindowMismatch on divergence
-            ph["collect"] += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            for block, result in results:
-                td = (
-                    self.blockchain.get_total_difficulty(block.number - 1)
-                    or 0
-                ) + block.header.difficulty
-                # world=None: the window already persisted the nodes
-                self.blockchain.save_block(
-                    block, result.receipts, td, world=None
-                )
-                stats.blocks += 1
-                stats.txs += result.stats.tx_count
-                stats.gas += result.gas_used
-                stats.parallel_txs += result.stats.parallel_count
-                stats.conflicts += result.stats.conflict_count
-            ph["save"] += time.perf_counter() - t0
-            if self.log is not None:
-                self.log(
-                    f"Committed window [{results[0][0].number}.."
-                    f"{results[-1][0].number}] ({len(results)} blocks) "
-                    "in one batched device pass"
-                )
+        def make_collect_job(cm: WindowCommitter, job, results):
+            # runs ON THE COLLECTOR THREAD, strictly FIFO
+            def run():
+                t0 = time.perf_counter()
+                cm.collect(job)  # raises WindowMismatch on divergence
+                t1 = time.perf_counter()
+                ph["collect_bg"] += t1 - t0
+                for block, result in results:
+                    td = (
+                        self.blockchain.get_total_difficulty(
+                            block.number - 1
+                        )
+                        or 0
+                    ) + block.header.difficulty
+                    # world=None: the window already persisted the nodes
+                    self.blockchain.save_block(
+                        block, result.receipts, td, world=None
+                    )
+                    stats.blocks += 1
+                    stats.txs += result.stats.tx_count
+                    stats.gas += result.gas_used
+                    stats.parallel_txs += result.stats.parallel_count
+                    stats.conflicts += result.stats.conflict_count
+                ph["save_bg"] += time.perf_counter() - t1
+                if self.log is not None:
+                    self.log(
+                        f"Committed window [{results[0][0].number}.."
+                        f"{results[-1][0].number}] ({len(results)} "
+                        "blocks) in one batched device pass"
+                    )
+
+            return run
 
         results_cur: List = []
         prev = parent
         import itertools
 
-        for block in itertools.chain((first,), blocks):
-            header = block.header
-            t0 = time.perf_counter()
-            # batch-recover + cache every sender in one native call
-            recover_senders(block.body.transactions)
-            ph["senders"] += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            if self.validate_headers:
-                self.header_validator.validate(header, prev)
-            BlockValidator.validate_body(block)
-            OmmersValidator.validate(
-                self.blockchain, block,
-                header_lookup=window_headers_full.get,
-                block_lookup=window_blocks.get,
-                header_validator=(
-                    self.header_validator
-                    if self.validate_headers else None
-                ),
-            )
-            config = for_block(header.number, self.config.blockchain)
-            if not config.byzantium:
-                raise ValueError(
-                    "window commits need Byzantium receipts "
-                    "(pre-Byzantium receipts embed per-tx roots)"
-                )
-            ph["validate"] += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            result = execute_block(
-                block,
-                b"",  # the open session IS the parent state
-                committer.make_world,
-                self.config,
-                validate=True,
-                check_root=False,  # deferred to window finalize
-            )
-            ph["execute"] += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            committer.commit_block(result.world, header)
-            ph["commit"] += time.perf_counter() - t0
-            window_headers[header.number] = header.hash
-            window_headers_full[header.number] = header
-            window_blocks[header.number] = block
-            results_cur.append((block, result))
-            prev = header
-            if len(results_cur) >= window_size:
-                # the PREVIOUS window must be collected before seal:
-                # seal substitutes its resolved hashes into this one
-                while in_flight:
-                    collect_one()
-                blocks_since_reset += len(results_cur)
+        try:
+            for block in itertools.chain((first,), blocks):
+                header = block.header
                 t0 = time.perf_counter()
-                in_flight.append((committer.seal(), results_cur))
+                # batch-recover + cache every sender in one native call
+                recover_senders(block.body.transactions)
+                ph["senders"] += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                if self.validate_headers:
+                    self.header_validator.validate(header, prev)
+                BlockValidator.validate_body(block)
+                OmmersValidator.validate(
+                    self.blockchain, block,
+                    header_lookup=window_headers_full.get,
+                    block_lookup=window_blocks.get,
+                    header_validator=(
+                        self.header_validator
+                        if self.validate_headers else None
+                    ),
+                )
+                config = for_block(header.number, self.config.blockchain)
+                if not config.byzantium:
+                    raise ValueError(
+                        "window commits need Byzantium receipts "
+                        "(pre-Byzantium receipts embed per-tx roots)"
+                    )
+                ph["validate"] += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                result = execute_block(
+                    block,
+                    b"",  # the open session IS the parent state
+                    committer.make_world,
+                    self.config,
+                    validate=True,
+                    check_root=False,  # deferred to window finalize
+                )
+                ph["execute"] += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                committer.commit_block(result.world, header)
+                ph["commit"] += time.perf_counter() - t0
+                window_headers[header.number] = header.hash
+                window_headers_full[header.number] = header
+                window_blocks[header.number] = block
+                results_cur.append((block, result))
+                prev = header
+                if len(results_cur) >= window_size:
+                    # NO barrier before seal: cross-window refs resolve
+                    # from the in-flight jobs' device digests (resolved-
+                    # input tiles); the only wait is submit backpressure
+                    # once pipeline_depth windows are queued
+                    blocks_since_reset += len(results_cur)
+                    t0 = time.perf_counter()
+                    job = committer.seal()
+                    ph["seal"] += time.perf_counter() - t0
+                    ph["collect"] += collector.submit(
+                        make_collect_job(committer, job, results_cur)
+                    )
+                    results_cur = []
+                    if blocks_since_reset >= epoch:
+                        # drain the pipeline, then restart the session from
+                        # the last validated root (memory bound)
+                        ph["collect"] += collector.drain()
+                        committer = make_committer(prev.state_root)
+                        blocks_since_reset = 0
+                        # header/body maps: ommers reach back 6 ancestors,
+                        # BLOCKHASH 256 — prune beyond that
+                        for d, keep in (
+                            (window_headers, 260),
+                            (window_headers_full, 8),
+                            (window_blocks, 8),
+                        ):
+                            for n in sorted(d)[:-keep]:
+                                del d[n]
+            if results_cur:
+                t0 = time.perf_counter()
+                job = committer.seal()
                 ph["seal"] += time.perf_counter() - t0
-                results_cur = []
-                if blocks_since_reset >= epoch:
-                    # collect the just-sealed window, then restart the
-                    # session from its validated root (memory bound)
-                    while in_flight:
-                        collect_one()
-                    committer = make_committer(prev.state_root)
-                    blocks_since_reset = 0
-                    # header/body maps: ommers reach back 6 ancestors,
-                    # BLOCKHASH 256 — prune beyond that
-                    for d, keep in (
-                        (window_headers, 260),
-                        (window_headers_full, 8),
-                        (window_blocks, 8),
-                    ):
-                        for n in sorted(d)[:-keep]:
-                            del d[n]
-        while in_flight:
-            collect_one()
-        if results_cur:
-            t0 = time.perf_counter()
-            job = committer.seal()
-            ph["seal"] += time.perf_counter() - t0
-            in_flight.append((job, results_cur))
-            collect_one()
+                ph["collect"] += collector.submit(
+                    make_collect_job(committer, job, results_cur)
+                )
+            ph["collect"] += collector.drain()
+        except BaseException:
+            # a driver-side failure (validation, execution, or a
+            # re-raised collector failure) aborts the pipeline:
+            # queued windows are dropped WITHOUT persisting
+            collector.kill()
+            raise
+        collector.close()
         stats.seconds = time.perf_counter() - t_start
+        # overlap fraction: collector busy seconds NOT spent with the
+        # driver blocked on it ((C - stall)/C) — 1.0 means collect+save
+        # were fully hidden behind host execution
+        stall = ph["collect"] + ph["save"]
+        busy = collector.busy_seconds
+        occ = (
+            max(0.0, min(1.0, (busy - stall) / busy)) if busy > 0 else 0.0
+        )
+        stats.pipeline_occupancy = occ
+        PIPELINE_GAUGES["occupancy"] = round(occ, 4)
+        PIPELINE_GAUGES["driver_stall_s"] = round(stall, 3)
         return stats
 
     def _execute_and_insert(self, block: Block, stats: ReplayStats) -> None:

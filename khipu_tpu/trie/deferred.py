@@ -150,6 +150,58 @@ def _substitute_bytes(value: bytes, mapping: Dict[bytes, bytes]) -> bytes:
     return bytes(out)
 
 
+def _substitute_many(encs: List[bytes], lookup) -> List[bytes]:
+    """Batched :func:`_substitute_bytes` over many encodings: ONE numpy
+    scan of the joined buffer finds every placeholder-prefix occurrence
+    (18 vectorized byte-compare refinements) instead of a Python
+    ``find`` loop per node — the dominant host cost of the window
+    collect path. ``lookup(ph) -> real | None`` decides substitution;
+    an occurrence whose 32 bytes are not a known placeholder (opaque
+    data that collided with the prefix, or a foreign counter range) is
+    left untouched, exactly like the scalar path."""
+    import numpy as np
+
+    total = sum(map(len, encs))
+    if total < 32:
+        return [bytes(e) for e in encs]
+    joined = b"".join(encs)
+    buf = np.frombuffer(joined, dtype=np.uint8).copy()
+    pref = np.frombuffer(_PLACEHOLDER_PREFIX, dtype=np.uint8)
+    cand = np.flatnonzero(buf[: total - 31] == pref[0])
+    for k in range(1, len(pref)):
+        if not cand.size:
+            break
+        cand = cand[buf[cand + k] == pref[k]]
+    hits: List[int] = []
+    digs: List[bytes] = []
+    if cand.size:
+        # boundary guard: in the JOINED buffer a prefix match could
+        # straddle two adjacent encodings — a real placeholder never
+        # does (it was written as one 32-byte ref inside one node)
+        ends = np.cumsum(
+            np.fromiter(map(len, encs), np.int64, len(encs))
+        )
+        node_end = ends[np.searchsorted(ends, cand, side="right")]
+        for p, e in zip(cand.tolist(), node_end.tolist()):
+            if p + 32 > e:
+                continue
+            real = lookup(joined[p : p + 32])
+            if real is not None:
+                hits.append(p)
+                digs.append(real)
+    if hits:
+        pos = np.asarray(hits, np.int64)
+        rep = np.frombuffer(b"".join(digs), np.uint8)
+        buf[(pos[:, None] + np.arange(32)).reshape(-1)] = rep
+    blob = buf.tobytes()
+    out: List[bytes] = []
+    off = 0
+    for e in encs:
+        out.append(blob[off : off + len(e)])
+        off += len(e)
+    return out
+
+
 def _substitute(structure, mapping: Dict[bytes, bytes]):
     """Replace placeholder refs (and embedded ones) inside a decoded
     node structure."""

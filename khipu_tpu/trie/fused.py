@@ -81,15 +81,24 @@ def _pow2(n: int, floor: int = 1) -> int:
 
 @functools.lru_cache(maxsize=64)
 def _build_fused(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
-                 use_jnp: bool):
+                 use_jnp: bool, ext_rows: int = 0):
     """Compile the fixpoint program for a shape signature.
 
     sig: per class (nblocks, nrows, nsubs), nrows % TILE == 0.
     Inputs: for each class, enc u8[nrows, nblocks*RATE]; then for each
     class rows i32[nsubs], offs i32[nsubs], child i32[nsubs] — the
     x32 byte-index expansion happens ON DEVICE (uploading pre-expanded
-    index arrays tripled the per-window transfer through the tunnel).
+    index arrays tripled the per-window transfer through the tunnel);
+    finally ext u8[ext_rows, 32] — RESOLVED-INPUT TILES: final digests
+    of a previous (possibly still in-flight) window's nodes, consumed
+    device-to-device so cross-window placeholder refs resolve without
+    a host round-trip (the deep-pipeline seam — ledger/window.seal).
     Output: concatenated digests u8[sum nrows, 32].
+
+    Substitution child indices address the concatenated [G; ext] digest
+    space: this window's rows first (class-major), then the ext rows —
+    one gather serves both intra-window fixpoint refs and cross-window
+    final refs.
 
     ``use_jnp``: hash via the jnp sponge (XLA-compiled, the CPU/test
     path) instead of the Pallas kernel (TPU) — pallas interpret mode is
@@ -114,7 +123,8 @@ def _build_fused(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
     @jax.jit
     def run(*args):
         encs = list(args[:k])
-        subs = args[k:]
+        subs = args[k : 4 * k]
+        ext = args[4 * k]  # u8[ext_rows, 32] resolved-input tiles
 
         def hash_all(encs):
             return jnp.concatenate(
@@ -126,6 +136,7 @@ def _build_fused(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
         def body(_, carry):
             encs, _ = carry
             G = hash_all(encs)
+            Gf = jnp.concatenate([G, ext], axis=0)
             new_encs = []
             for c in range(k):
                 rows = subs[3 * c]
@@ -133,7 +144,7 @@ def _build_fused(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
                 child = subs[3 * c + 2]
                 rows32 = jnp.repeat(rows, 32)
                 cols32 = (offs[:, None] + idx32).reshape(-1)
-                vals = G[child].reshape(-1)  # [nsubs*32] u8
+                vals = Gf[child].reshape(-1)  # [nsubs*32] u8
                 new_encs.append(encs[c].at[rows32, cols32].set(vals))
             return new_encs, G
 
@@ -151,25 +162,49 @@ class FusedJob:
     have not been fetched. ``collect`` blocks on the single device->host
     transfer. This is the double-buffering seam: the caller executes the
     NEXT window's transactions on the host while this window's fixpoint
-    program runs on device (SURVEY §7.4-5)."""
+    program runs on device (SURVEY §7.4-5).
 
-    __slots__ = ("digests", "class_rows")
+    ``digests`` stays referenced after collect so a LATER window's
+    dispatch can gather rows from it device-to-device (resolved-input
+    tiles — the deep-pipeline cross-window mechanism); ``dpos`` maps
+    each placeholder to its row for that gather."""
 
-    def __init__(self, digests, class_rows):
+    __slots__ = ("digests", "class_rows", "dpos", "_mapping")
+
+    def __init__(self, digests, class_rows, dpos=None):
         self.digests = digests  # device u8[sum rows, 32]
         self.class_rows = class_rows  # [(phs in row order, global base)]
+        self.dpos = dpos or {}  # ph -> global row (cross-window gather)
+        self._mapping: Dict[bytes, bytes] = None
 
     def collect(self) -> Dict[bytes, bytes]:
+        if self._mapping is not None:
+            return self._mapping
         if self.digests is None:
             return {}
         import jax
 
         d = np.asarray(jax.device_get(self.digests))
+        # ONE device fetch, ONE bytes copy, then pure slicing — the
+        # per-row `d[i].tobytes()` loop paid a numpy indexing round per
+        # node and dominated the collect phase (BENCH_r05)
+        blob = d.tobytes()
         out: Dict[bytes, bytes] = {}
         for rows, base in self.class_rows:
-            for r, ph in enumerate(rows):
-                out[ph] = d[base + r].tobytes()
+            o = base * 32
+            out.update(
+                zip(
+                    rows,
+                    (blob[o + 32 * r : o + 32 * r + 32]
+                     for r in range(len(rows))),
+                )
+            )
+        self._mapping = out
         return out
+
+
+EXT_FLOOR = 64  # min padded rows of the resolved-input tile (pow-2
+# bucketing keeps windows with 0..64 cross-refs in ONE compiled shape)
 
 
 def fused_resolve(
@@ -188,6 +223,7 @@ def fused_submit(
     prefix: bytes,
     use_jnp: bool = False,
     depth: int = None,
+    ext=None,
 ) -> FusedJob:
     """Pack + dispatch the fixpoint program that resolves placeholder ->
     real Keccak-256 hash for every entry of ``to_resolve`` (placeholder
@@ -198,6 +234,14 @@ def fused_submit(
     placeholder prefix for the offset scan. Callers that know the DAG
     depth (bulk build has it from the height pass) pass ``depth`` to
     skip the O(depth x nodes) topological scan.
+
+    ``ext``: optional ``(digests, pos)`` resolved-input tile — a device
+    u8[n, 32] array of FINAL digests from earlier windows (typically
+    gathered from an in-flight FusedJob's output, device-to-device) and
+    a ``ph -> row`` map. Encodings that still embed those windows'
+    placeholder bytes get them substituted ON DEVICE from the tile, so
+    a window can be sealed and dispatched while its predecessor is
+    still hashing (the seal/collect barrier removal).
     """
     if not to_resolve:
         return FusedJob(None, [])
@@ -238,6 +282,12 @@ def fused_submit(
             dpos[ph] = base + r
         base += nrows_pad[nb]
 
+    total_rows = base  # ext tiles are indexed past this window's rows
+    ext_pos: Dict[bytes, int] = {}
+    ext_dev = None
+    if ext is not None:
+        ext_dev, ext_pos = ext
+
     enc_bufs: List[np.ndarray] = []
     sub_arrays: List[np.ndarray] = []
     sig: List[Tuple[int, int, int]] = []
@@ -259,7 +309,12 @@ def fused_submit(
             lens[r] = len(enc)
             pos = enc.find(prefix)
             while pos >= 0:
-                cp = dpos.get(enc[pos : pos + 32])
+                child = enc[pos : pos + 32]
+                cp = dpos.get(child)
+                if cp is None and ext_pos:
+                    ep = ext_pos.get(child)
+                    if ep is not None:
+                        cp = total_rows + ep  # resolved-input tile row
                 if cp is not None:
                     subs.append((r, pos, cp))
                 pos = enc.find(prefix, pos + 32)
@@ -293,10 +348,28 @@ def fused_submit(
         )
         sig.append((nb, nrows_pad[nb], nsubs))
 
-    rounds = _pow2(depth, floor=8)  # coarse: depth 5 and 8 share a compile
-    run = _build_fused(tuple(sig), rounds, use_jnp)
+    # resolved-input tile: always an input (a dummy zero tile when the
+    # window has no cross-refs) so every window shares one compiled
+    # signature family regardless of pipeline depth
+    n_ext = ext_dev.shape[0] if ext_dev is not None else 0
+    ext_rows = _pow2(max(n_ext, 1), floor=EXT_FLOOR)
+    if ext_dev is None:
+        ext_buf = np.zeros((ext_rows, 32), dtype=np.uint8)
+    elif n_ext != ext_rows:
+        import jax.numpy as jnp
 
-    digests = run(*[*enc_bufs, *sub_arrays])  # async: no host sync here
+        ext_buf = (
+            jnp.zeros((ext_rows, 32), dtype=jnp.uint8)
+            .at[:n_ext]
+            .set(ext_dev)
+        )
+    else:
+        ext_buf = ext_dev
+
+    rounds = _pow2(depth, floor=8)  # coarse: depth 5 and 8 share a compile
+    run = _build_fused(tuple(sig), rounds, use_jnp, ext_rows)
+
+    digests = run(*[*enc_bufs, *sub_arrays, ext_buf])  # async: no host sync
     try:
         # start the device->host copy NOW: it streams as soon as the
         # fixpoint finishes, so collect()'s device_get returns without
@@ -309,4 +382,4 @@ def fused_submit(
     for nb in class_list:
         class_rows.append((classes[nb], base))
         base += nrows_pad[nb]
-    return FusedJob(digests, class_rows)
+    return FusedJob(digests, class_rows, dpos)
