@@ -63,7 +63,7 @@ def _replay_keys(nsenders, seed_base=1):
 
 
 def _replay_fixture(parallel, window, alloc, build_blocks, device_commit,
-                    pipeline_depth=2):
+                    pipeline_depth=2, trace=False):
     """Shared replay-bench scaffolding: build a fixture chain through the
     ChainBuilder, round-trip through wire RLP (replay must pay sender
     recovery + parse like a real sync), then replay into a fresh chain
@@ -109,16 +109,79 @@ def _replay_fixture(parallel, window, alloc, build_blocks, device_commit,
         )
     target = Blockchain(Storages(), cfg)
     target.load_genesis(GenesisSpec(alloc=alloc))
+    if trace:
+        # drop chain-build/warm-up spans: the breakdown must cover
+        # exactly the timed replay below
+        from khipu_tpu.observability.trace import tracer
+
+        tracer.reset()
     driver = ReplayDriver(target, cfg, device_commit=device_commit)
     return driver.replay(blocks)
 
 
-def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
-                 note=None, pipeline_depth=2):
-    """Configs #1/#4: build a fixture chain, then time a validated
-    replay into a fresh chain DB with device trie commits (windowed:
-    one batched device pass per `window` blocks, up to
-    ``pipeline_depth`` windows sealed-but-uncollected in flight)."""
+def _trace_report(stats):
+    """Per-phase breakdown of the spans the timed replay recorded, the
+    split ``--trace`` prints next to blocks/s. driver_total_s is the
+    sum of top-level DRIVER phases — those tile the driver's wall clock
+    (collector phases overlap them on the background thread), so it
+    must land within a few percent of stats.seconds; the smoke test
+    asserts exactly that."""
+    from khipu_tpu.observability import recorder
+    from khipu_tpu.observability.trace import tracer
+
+    spans = tracer.snapshot()
+    breakdown = recorder.phase_breakdown(spans)
+    log = recorder.compile_log.snapshot()
+    return {
+        "phase_seconds": breakdown,
+        "driver_total_s": round(
+            sum(v for k, v in breakdown.items()
+                if k in recorder.DRIVER_PHASES), 4
+        ),
+        "wall_s": round(stats.seconds, 4),
+        "occupancy_spans": round(recorder.occupancy(spans), 4),
+        "occupancy_gauge": round(stats.pipeline_occupancy, 4),
+        "spans": len(spans),
+        "dropped": tracer.dropped,
+        "compile_cache": {
+            k: log[k] for k in ("hits", "misses", "evictions")
+        },
+    }
+
+
+def run_traced_replay(n_blocks=32, txs_per_block=50, window=4,
+                      pipeline_depth=4, device_commit=True,
+                      chrome_out=None):
+    """The pipelined-replay bench with the flight recorder ON: returns
+    (stats, report) where report is _trace_report's breakdown. The
+    --trace CLI wraps this with device_commit=True; the smoke test
+    calls it with a tiny chain and device_commit=False (host hasher —
+    no multi-second XLA compile inside a 'not slow' test)."""
+    from khipu_tpu.observability.trace import tracer
+
+    tracer.enable()
+    try:
+        stats = _bench_replay_stats(
+            n_blocks, txs_per_block, parallel=True, window=window,
+            pipeline_depth=pipeline_depth, device_commit=device_commit,
+            trace=True,
+        )
+        report = _trace_report(stats)
+        if chrome_out:
+            from khipu_tpu.observability import export
+
+            export.dump_chrome_trace(chrome_out)
+            report["chrome_trace"] = chrome_out
+    finally:
+        tracer.disable()
+    return stats, report
+
+
+def _bench_replay_stats(n_blocks, txs_per_block, parallel, window,
+                        pipeline_depth=2, device_commit=True,
+                        trace=False):
+    """Disjoint-transfer replay shape shared by bench_replay and
+    run_traced_replay; returns the ReplayStats."""
     from khipu_tpu.domain.transaction import Transaction, sign_transaction
 
     nsenders = min(max(txs_per_block, 2), 64)
@@ -152,9 +215,22 @@ def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
             blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
         return blocks
 
-    stats = _replay_fixture(
+    return _replay_fixture(
         parallel, window, {a: 10**24 for a in addrs}, build,
-        device_commit=True, pipeline_depth=pipeline_depth,
+        device_commit=device_commit, pipeline_depth=pipeline_depth,
+        trace=trace,
+    )
+
+
+def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
+                 note=None, pipeline_depth=2):
+    """Configs #1/#4: build a fixture chain, then time a validated
+    replay into a fresh chain DB with device trie commits (windowed:
+    one batched device pass per `window` blocks, up to
+    ``pipeline_depth`` windows sealed-but-uncollected in flight)."""
+    stats = _bench_replay_stats(
+        n_blocks, txs_per_block, parallel, window,
+        pipeline_depth=pipeline_depth,
     )
     emit(
         metric,
@@ -688,7 +764,34 @@ def bench_keccak_primary(N=1 << 20, L=576, ROUNDS=32):
     )
 
 
+def bench_replay_traced(chrome_out=None):
+    """``bench.py --trace``: the deep-pipeline headline config with the
+    flight recorder ON — emits the per-phase wall-clock breakdown (and
+    the span-derived occupancy next to the gauge) beside blocks/s.
+    Tracing cost is itself visible: compare this line's blocks/s
+    against replay_pipelined_blocks_per_sec from an untraced run."""
+    stats, report = run_traced_replay(
+        32, 50, window=4, pipeline_depth=4, chrome_out=chrome_out,
+    )
+    emit(
+        "replay_pipelined_blocks_per_sec_traced",
+        round(stats.blocks_per_s, 2),
+        "blocks/s",
+        txs=stats.txs,
+        window=4,
+        pipeline_depth=4,
+        **report,
+    )
+
+
 def main() -> None:
+    if "--trace" in sys.argv:
+        chrome_out = None
+        for arg in sys.argv[1:]:
+            if arg.startswith("--chrome-out="):
+                chrome_out = arg.split("=", 1)[1]
+        bench_replay_traced(chrome_out)
+        return
     bench_replay_pre_byzantium()
     bench_replay(
         120, 3, "replay_early_era_fixture_blocks_per_sec",

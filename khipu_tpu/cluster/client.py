@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Mapping, Optional, Sequence
 
 from khipu_tpu.base.crypto.keccak import keccak256
 from khipu_tpu.cluster.ring import HashRing
+from khipu_tpu.observability.trace import span
 
 # breaker states (CircuitBreaker pattern; Akka failure-detector role)
 CLOSED = "closed"
@@ -206,7 +207,10 @@ class ShardedNodeClient:
             m.requests += 1
             t0 = self._clock()
             try:
-                out = op(self._channel(endpoint))
+                with span(
+                    "cluster.call", endpoint=endpoint, attempt=attempt
+                ):
+                    out = op(self._channel(endpoint))
             except Exception as e:  # grpc.RpcError or fake failures
                 m.latency_ns += int((self._clock() - t0) * 1e9)
                 m.failures += 1
@@ -233,48 +237,57 @@ class ShardedNodeClient:
         are simply absent — the caller's miss semantics apply."""
         remaining = list(dict.fromkeys(bytes(h) for h in hashes))
         result: Dict[bytes, bytes] = {}
-        # per-request shard selection: group keys by their replica
-        # chain so one RPC serves each shard's share of the batch
-        groups: Dict[tuple, List[bytes]] = {}
-        for h in remaining:
-            groups.setdefault(tuple(self.ring.replicas_for(h)), []).append(h)
-        for chain, keys in groups.items():
-            want = keys
-            for rank, endpoint in enumerate(chain):
-                if not want:
-                    break
-                m = self.metrics[endpoint]
-                if rank > 0:
-                    m.failovers += 1
-                try:
-                    got = self._call(
-                        endpoint,
-                        lambda ch, w=tuple(want): ch.get_node_data(
-                            list(w)
-                        ),
-                    )
-                except Exception:
-                    continue  # next replica
-                still: List[bytes] = []
-                for h in want:
-                    v = got.get(h)
-                    if v is None:
-                        m.missing += 1
-                        still.append(h)
-                    elif keccak256(v) != h:
-                        m.corrupt += 1  # never admit wrong bytes
-                        still.append(h)
-                    else:
-                        m.served += 1
+        with span("cluster.fetch", keys=len(remaining)) as fetch_sp:
+            # per-request shard selection: group keys by their replica
+            # chain so one RPC serves each shard's share of the batch
+            groups: Dict[tuple, List[bytes]] = {}
+            for h in remaining:
+                groups.setdefault(
+                    tuple(self.ring.replicas_for(h)), []
+                ).append(h)
+            for chain, keys in groups.items():
+                want = keys
+                for rank, endpoint in enumerate(chain):
+                    if not want:
+                        break
+                    m = self.metrics[endpoint]
+                    if rank > 0:
+                        m.failovers += 1
+                    try:
+                        with span(
+                            "cluster.replica", endpoint=endpoint,
+                            rank=rank, keys=len(want),
+                            failover=rank > 0,
+                        ):
+                            got = self._call(
+                                endpoint,
+                                lambda ch, w=tuple(want): (
+                                    ch.get_node_data(list(w))
+                                ),
+                            )
+                    except Exception:
+                        continue  # next replica
+                    still: List[bytes] = []
+                    for h in want:
+                        v = got.get(h)
+                        if v is None:
+                            m.missing += 1
+                            still.append(h)
+                        elif keccak256(v) != h:
+                            m.corrupt += 1  # never admit wrong bytes
+                            still.append(h)
+                        else:
+                            m.served += 1
+                            result[h] = v
+                    want = still
+                for h in want:  # replica set exhausted: local fallback
+                    v = self.local_get(h) if self.local_get else None
+                    if v is not None and keccak256(v) == h:
+                        self.local_fallbacks += 1
                         result[h] = v
-                want = still
-            for h in want:  # replica set exhausted: local fallback
-                v = self.local_get(h) if self.local_get else None
-                if v is not None and keccak256(v) == h:
-                    self.local_fallbacks += 1
-                    result[h] = v
-                else:
-                    self.unreachable += 1
+                    else:
+                        self.unreachable += 1
+            fetch_sp.set_tag("served", len(result))
         return result
 
     # ----------------------------------------------------------- writes

@@ -123,6 +123,26 @@ class SyncConfig:
 
 
 @dataclass(frozen=True)
+class ObservabilityConfig:
+    """Flight recorder (observability/ package — docs/observability.md).
+
+    ``enabled=False`` (the default) keeps every ``span(...)`` seam an
+    attribute load + branch returning an inert singleton: bit-exact
+    identical replay behavior, no recording. Enabling sizes the
+    lock-light drop-oldest ring that trace.py records into."""
+
+    enabled: bool = False
+    ring_capacity: int = 65536  # spans retained (drop-oldest beyond)
+    # fused ext-tile signature cache bound (trie/fused.py): compiled
+    # fixpoint programs retained before LRU eviction; evictions/misses
+    # are counted in the compile-event log
+    compile_cache_capacity: int = 64
+    # when set, bench --trace / ServiceBoard dump Chrome trace_event
+    # JSON (perfetto-loadable) here on demand
+    chrome_trace_path: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class ClusterConfig:
     """Sharded node-cache cluster (cluster/ package; P6 scaled out —
     DistributedNodeStorage.scala:13-57 role). Empty ``endpoints``
@@ -147,6 +167,9 @@ class KhipuConfig:
     db: DbConfig = field(default_factory=DbConfig)
     sync: SyncConfig = field(default_factory=SyncConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig
+    )
 
 
 def fixture_config(

@@ -502,6 +502,34 @@ class EthService:
         }
         return out
 
+    def khipu_traces(self) -> dict:
+        """Flight-recorder summary (observability/export.snapshot):
+        ring/drop counters, traced block numbers, per-phase latency
+        percentiles, occupancy timeline and compile-cache pressure."""
+        from khipu_tpu.observability import export
+
+        return export.snapshot()
+
+    def khipu_trace_block(self, number) -> dict:
+        """Full lifecycle record of ONE block: every span tagged with
+        (or covering) its number, grouped into the canonical
+        announce -> import -> window.build -> ... -> window.persist
+        phase order with cross-thread parent links intact."""
+        from khipu_tpu.observability import export
+
+        n = parse_qty(number) if isinstance(number, str) else int(number)
+        return export.trace_block(n)
+
+    def khipu_dump_chrome_trace(self, path: str) -> dict:
+        """Write the ring's spans as Chrome trace_event JSON (load in
+        perfetto / chrome://tracing); returns {path, spans}."""
+        from khipu_tpu.observability import export
+        from khipu_tpu.observability.trace import tracer
+
+        spans = tracer.snapshot()
+        export.dump_chrome_trace(path, spans)
+        return {"path": path, "spans": len(spans)}
+
     # ------------------------------------------------------------ codecs
 
     @staticmethod

@@ -26,6 +26,7 @@ from khipu_tpu.base.crypto.keccak import keccak256
 from khipu_tpu.domain.account import address_key
 from khipu_tpu.domain.block_header import BlockHeader
 from khipu_tpu.ledger.world import BlockWorldState
+from khipu_tpu.observability.trace import span
 from khipu_tpu.trie.bulk import Hasher, host_hasher
 from khipu_tpu.trie.deferred import (
     DeferredMPT,
@@ -251,63 +252,69 @@ class WindowCommitter:
         # at node creation and tries build bottom-up, so a child's
         # index is always below its parent's — by the time a parent is
         # scanned, every child's depth is known
-        for idx in range(start, end):
-            ph = _make_placeholder(idx)
-            enc = self._staged.get(ph)
-            if enc is None:
-                continue  # e.g. another session's counter range
-            pos = enc.find(_PLACEHOLDER_PREFIX)
-            if pos < 0:
-                to_resolve[ph] = enc
-                deps[ph] = []
-                depth_of[ph] = 1
-                if max_depth < 1:
-                    max_depth = 1
-                continue
-            out = bytearray(enc)
-            children: List[bytes] = []
-            d = 1
-            while pos >= 0:
-                child = bytes(out[pos : pos + 32])
-                real = resolved_global.get(child)
-                if real is not None:
-                    out[pos : pos + 32] = real
-                else:
-                    cd = depth_of.get(child)
-                    if cd is not None:
-                        children.append(child)
-                        if cd >= d:
-                            d = cd + 1
+        with span("window.pack") as pack_sp:
+            for idx in range(start, end):
+                ph = _make_placeholder(idx)
+                enc = self._staged.get(ph)
+                if enc is None:
+                    continue  # e.g. another session's counter range
+                pos = enc.find(_PLACEHOLDER_PREFIX)
+                if pos < 0:
+                    to_resolve[ph] = enc
+                    deps[ph] = []
+                    depth_of[ph] = 1
+                    if max_depth < 1:
+                        max_depth = 1
+                    continue
+                out = bytearray(enc)
+                children: List[bytes] = []
+                d = 1
+                while pos >= 0:
+                    child = bytes(out[pos : pos + 32])
+                    real = resolved_global.get(child)
+                    if real is not None:
+                        out[pos : pos + 32] = real
                     else:
-                        src = inflight_rows.get(child)
-                        if src is not None:
-                            ext_refs[child] = src
+                        cd = depth_of.get(child)
+                        if cd is not None:
+                            children.append(child)
+                            if cd >= d:
+                                d = cd + 1
                         else:
-                            # the background collector may have
-                            # resolved this window between the first
-                            # resolved_global probe and the in-flight
-                            # probe (it publishes hashes BEFORE
-                            # dropping the in-flight rows) — re-check
-                            real = resolved_global.get(child)
-                            if real is not None:
-                                out[pos : pos + 32] = real
-                            elif child in self._staged:
-                                # neither this window's, nor resolved,
-                                # nor in flight: a foreign session
-                                # sharing the staged namespace —
-                                # hashing would bake placeholder
-                                # bytes into the node
-                                raise AssertionError(
-                                    "seal(): unresolvable placeholder "
-                                    "ref (foreign session sharing the "
-                                    "staged namespace?)"
-                                )
-                pos = out.find(_PLACEHOLDER_PREFIX, pos + 32)
-            to_resolve[ph] = bytes(out)
-            deps[ph] = children
-            depth_of[ph] = d
-            if d > max_depth:
-                max_depth = d
+                            src = inflight_rows.get(child)
+                            if src is not None:
+                                ext_refs[child] = src
+                            else:
+                                # the background collector may have
+                                # resolved this window between the first
+                                # resolved_global probe and the
+                                # in-flight probe (it publishes hashes
+                                # BEFORE dropping the in-flight rows) —
+                                # re-check
+                                real = resolved_global.get(child)
+                                if real is not None:
+                                    out[pos : pos + 32] = real
+                                elif child in self._staged:
+                                    # neither this window's, nor
+                                    # resolved, nor in flight: a foreign
+                                    # session sharing the staged
+                                    # namespace — hashing would bake
+                                    # placeholder bytes into the node
+                                    raise AssertionError(
+                                        "seal(): unresolvable "
+                                        "placeholder ref (foreign "
+                                        "session sharing the staged "
+                                        "namespace?)"
+                                    )
+                    pos = out.find(_PLACEHOLDER_PREFIX, pos + 32)
+                to_resolve[ph] = bytes(out)
+                deps[ph] = children
+                depth_of[ph] = d
+                if d > max_depth:
+                    max_depth = d
+            pack_sp.set_tag("nodes", len(to_resolve))
+            pack_sp.set_tag("depth", max_depth)
+            pack_sp.set_tag("ext_refs", len(ext_refs))
 
         job = WindowJob(self, pending, to_resolve, live)
         job.codes, self._window_codes = self._window_codes, []
@@ -352,12 +359,14 @@ class WindowCommitter:
                     child, "is referenced across windows but has no digest"
                 )
             mapping[child] = real
-        for level in topo_levels(deps):
-            encodings = [
-                _substitute_bytes(to_resolve[ph], mapping) for ph in level
-            ]
-            digests = self.hasher(encodings)
-            mapping.update(zip(level, digests))
+        with span("window.hash", nodes=len(to_resolve)):
+            for level in topo_levels(deps):
+                encodings = [
+                    _substitute_bytes(to_resolve[ph], mapping)
+                    for ph in level
+                ]
+                digests = self.hasher(encodings)
+                mapping.update(zip(level, digests))
         job.mapping = mapping
         # digests are FINAL here — publish now so the next seal resolves
         # this window's refs without a barrier (persistence is still
@@ -425,13 +434,16 @@ class WindowCommitter:
         resolved_global = self._resolved_global
 
         results: List[Tuple[BlockHeader, bytes]] = []
-        for header, root_ref in job.pending_blocks:
-            real = mapping.get(root_ref) or resolved_global.get(
-                root_ref, root_ref
-            )
-            if real != header.state_root:
-                raise WindowMismatch(header.number, real, header.state_root)
-            results.append((header, real))
+        with span("window.rootcheck", blocks=len(job.pending_blocks)):
+            for header, root_ref in job.pending_blocks:
+                real = mapping.get(root_ref) or resolved_global.get(
+                    root_ref, root_ref
+                )
+                if real != header.state_root:
+                    raise WindowMismatch(
+                        header.number, real, header.state_root
+                    )
+                results.append((header, real))
 
         # persist LIVE nodes only (dead intermediates were hashed for the
         # root checks but nothing references them), routed by session
@@ -458,17 +470,18 @@ class WindowCommitter:
             v = _m.get(ref)
             return v if v is not None else _g.get(ref)
 
-        subbed = _substitute_many(encs, _lookup)
-        account_nodes: Dict[bytes, bytes] = {}
-        storage_nodes: Dict[bytes, bytes] = {}
-        storage_phs = self._storage_phs
-        for ph, real, enc in zip(live_phs, reals, subbed):
-            if ph in storage_phs:
-                storage_nodes[real] = enc
-            else:
-                account_nodes[real] = enc
-        self.storages.account_node_storage.update([], account_nodes)
-        self.storages.storage_node_storage.update([], storage_nodes)
+        with span("window.store", live=len(live_phs)):
+            subbed = _substitute_many(encs, _lookup)
+            account_nodes: Dict[bytes, bytes] = {}
+            storage_nodes: Dict[bytes, bytes] = {}
+            storage_phs = self._storage_phs
+            for ph, real, enc in zip(live_phs, reals, subbed):
+                if ph in storage_phs:
+                    storage_nodes[real] = enc
+                else:
+                    account_nodes[real] = enc
+            self.storages.account_node_storage.update([], account_nodes)
+            self.storages.storage_node_storage.update([], storage_nodes)
         # only THIS window's codes persist (later windows' roots are
         # still unchecked; their codes stay staged until their collect)
         staged_codes = self._evmcode_source.staged

@@ -1,0 +1,22 @@
+"""Flight-recorder observability: block-lifecycle tracing, profiling
+hooks, and trace exports (docs/observability.md).
+
+* trace.py — nestable spans with explicit cross-thread parent links
+  into a fixed-size lock-light ring buffer; near-zero cost and
+  bit-exact identical behavior when disabled (the default).
+* recorder.py — per-block lifecycle records, pipeline-occupancy
+  timeline, phase latency percentiles, fused compile-event log.
+* export.py — ``khipu_traces`` / ``khipu_trace_block`` RPC payloads
+  and Chrome ``trace_event`` JSON for perfetto.
+"""
+
+from khipu_tpu.observability.trace import (  # noqa: F401
+    Tracer,
+    apply_config,
+    current_token,
+    disable,
+    enable,
+    event,
+    span,
+    tracer,
+)

@@ -1,0 +1,136 @@
+"""Serve the flight recorder: metrics-RPC payloads + Chrome trace JSON.
+
+Two consumers, one snapshot discipline (every export works on ONE
+``tracer.snapshot()`` so a live workload can't tear a report):
+
+* ``khipu_traces`` / ``khipu_trace_block(n)`` over the existing
+  JSON-RPC metrics surface (jsonrpc/eth_service.py) — structured
+  aggregates for dashboards and the acceptance gates;
+* ``chrome_trace()`` / ``dump_chrome_trace(path)`` — Chrome
+  ``trace_event`` JSON (the ``traceEvents`` array format) loadable in
+  perfetto / chrome://tracing. Spans become complete ("X") events;
+  explicit cross-thread parent links additionally emit a flow pair
+  ("s" at the parent, "f" at the child, bound by the parent span id)
+  so the driver->collector handoff renders as an arrow across thread
+  tracks.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence
+
+from khipu_tpu.observability import recorder
+from khipu_tpu.observability.trace import Span, tracer
+
+
+def _sanitize(v):
+    return v.hex() if isinstance(v, bytes) else v
+
+
+# ------------------------------------------------------------ RPC side
+
+
+def snapshot() -> dict:
+    """The ``khipu_traces`` payload: recorder state + aggregates."""
+    spans = tracer.snapshot()
+    out = {
+        "enabled": tracer.enabled,
+        "capacity": tracer.capacity,
+        "recorded": tracer.recorded,
+        "buffered": len(spans),
+        "dropped": tracer.dropped,
+        "blocks": recorder.traced_blocks(spans),
+        "phasePercentiles": recorder.phase_percentiles(spans),
+        "phaseBreakdownSeconds": recorder.phase_breakdown(spans),
+        "occupancy": round(recorder.occupancy(spans), 4),
+        "occupancyTimeline": recorder.occupancy_timeline(spans),
+        "compileCache": recorder.compile_log.snapshot(),
+    }
+    try:
+        from khipu_tpu.trie.fused import compile_cache
+
+        out["compileCache"].update(compile_cache.stats())
+    except Exception:
+        pass
+    return out
+
+
+def trace_block(number: int) -> dict:
+    """The ``khipu_trace_block(n)`` payload: the block's lifecycle
+    record (recorder.lifecycle) from the current ring contents."""
+    return recorder.lifecycle(tracer.snapshot(), number)
+
+
+# --------------------------------------------------------- trace_event
+
+
+def _us(t_perf: float) -> float:
+    """perf_counter stamp -> microseconds since the tracer epoch."""
+    return round((t_perf - tracer.epoch_perf) * 1e6, 3)
+
+
+def chrome_trace(spans: Optional[Sequence[Span]] = None) -> dict:
+    """Chrome ``trace_event`` JSON object format for the given spans
+    (default: the live ring). One process, one track per thread."""
+    if spans is None:
+        spans = tracer.snapshot()
+    by_id = {s.sid: s for s in spans}
+    events: List[dict] = []
+    threads = {}
+    for s in spans:
+        if s.tid not in threads:
+            threads[s.tid] = s.thread_name or f"thread-{s.tid}"
+    # thread-name metadata first, so tracks are labeled
+    for tid, name in sorted(threads.items()):
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+            "args": {"name": name},
+        })
+    for s in spans:
+        args = {k: _sanitize(v) for k, v in s.tags.items()}
+        if s.parent is not None:
+            args["parentSpan"] = s.parent
+        if s.error:
+            args["error"] = True
+        args["cpu_ms"] = round(s.cpu * 1e3, 3)
+        base = {"name": s.name, "pid": 1, "tid": s.tid, "args": args}
+        if s.t1 > s.t0:
+            events.append({
+                **base, "ph": "X", "ts": _us(s.t0),
+                "dur": round(s.duration * 1e6, 3),
+            })
+        else:
+            events.append({**base, "ph": "i", "ts": _us(s.t0), "s": "t"})
+        # explicit cross-thread causality: a flow arrow from the parent
+        # span's start to this span's start
+        p = by_id.get(s.parent) if s.parent is not None else None
+        if p is not None and p.tid != s.tid:
+            flow_id = s.parent
+            events.append({
+                "name": f"{p.name}→{s.name}", "ph": "s",
+                "id": flow_id, "pid": 1, "tid": p.tid,
+                "ts": _us(p.t0), "cat": "handoff",
+            })
+            events.append({
+                "name": f"{p.name}→{s.name}", "ph": "f",
+                "bp": "e", "id": flow_id, "pid": 1, "tid": s.tid,
+                "ts": _us(s.t0), "cat": "handoff",
+            })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "recorder": "khipu-tpu flight recorder",
+            "dropped": tracer.dropped,
+            "epochUnixSeconds": tracer.epoch_wall,
+        },
+    }
+
+
+def dump_chrome_trace(path: str,
+                      spans: Optional[Sequence[Span]] = None) -> str:
+    """Write the perfetto-loadable JSON to ``path``; returns the path."""
+    with open(path, "w") as f:
+        json.dump(chrome_trace(spans), f)
+    return path

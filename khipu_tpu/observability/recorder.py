@@ -1,0 +1,321 @@
+"""Aggregate raw spans into the records operators actually ask for.
+
+trace.py records flat spans; this module turns a snapshot of them into
+
+* per-block LIFECYCLE records — every phase a block passed through
+  (announce -> import -> window.build -> window.seal [-> fused.dispatch]
+  -> window.collect -> window.persist), each with wall interval, thread
+  and parent link, so ``khipu_trace_block(n)`` answers "where did block
+  n spend its time" across the driver/collector boundary;
+* a pipeline-occupancy TIMELINE — driver-busy vs collector-busy
+  coverage per time bucket, whose aggregate agrees with the
+  ``pipeline_occupancy`` gauge (sync/replay.py) by construction: both
+  compute (collector_busy - driver_stall) / collector_busy;
+* per-phase latency PERCENTILES (p50/p90/p99);
+* the COMPILE-EVENT log — every fused ext-tile signature-cache access
+  (hit / miss+compile / eviction, trie/fused.py) with counters. The
+  log is always on: one append per cache access (once per sealed
+  window at steady state) is noise, and compile storms are precisely
+  the thing you need visible when tracing was off.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Sequence
+
+from khipu_tpu.observability.trace import Span, tracer
+
+# canonical lifecycle phase names, in pipeline order. Instrumentation
+# seams use EXACTLY these strings (plus dotted suffixes for sub-steps)
+# so the recorder can group without a registry.
+PHASE_ANNOUNCE = "announce"
+PHASE_IMPORT = "import"
+PHASE_BUILD = "window.build"
+PHASE_SEAL = "window.seal"
+PHASE_DISPATCH = "fused.dispatch"
+PHASE_COLLECT = "window.collect"
+PHASE_PERSIST = "window.persist"
+PHASE_STALL = "pipeline.stall"
+
+LIFECYCLE_PHASES = (
+    PHASE_ANNOUNCE, PHASE_IMPORT, PHASE_BUILD, PHASE_SEAL,
+    PHASE_DISPATCH, PHASE_COLLECT, PHASE_PERSIST,
+)
+# phases a windowed-replay block must traverse for its record to be
+# "complete" (announce/import appear only on the live-sync path;
+# fused.dispatch only under device commit)
+REQUIRED_PHASES = (PHASE_BUILD, PHASE_SEAL, PHASE_COLLECT, PHASE_PERSIST)
+
+DRIVER_PHASES = (PHASE_ANNOUNCE, PHASE_IMPORT, PHASE_BUILD, PHASE_SEAL,
+                 PHASE_STALL)
+COLLECTOR_PHASES = (PHASE_COLLECT, PHASE_PERSIST)
+
+
+def spans_for_block(spans: Iterable[Span], number: int) -> List[Span]:
+    """Spans tagged with block ``number`` — either exactly (``block=n``)
+    or by window range (``block_lo <= n <= block_hi``)."""
+    out = []
+    for s in spans:
+        tags = s.tags
+        if tags.get("block") == number:
+            out.append(s)
+            continue
+        lo = tags.get("block_lo")
+        if lo is not None and lo <= number <= tags.get("block_hi", lo):
+            out.append(s)
+    return out
+
+
+def _span_json(s: Span) -> dict:
+    return {
+        "span": s.sid,
+        "parent": s.parent,
+        "name": s.name,
+        "thread": s.thread_name or s.tid,
+        "start": round(s.t0 - tracer.epoch_perf, 6),
+        "duration": round(s.duration, 6),
+        "cpu": round(s.cpu, 6),
+        "tags": {
+            k: (v.hex() if isinstance(v, bytes) else v)
+            for k, v in s.tags.items()
+        },
+        **({"error": True} if s.error else {}),
+    }
+
+
+def lifecycle(spans: Sequence[Span], number: int) -> dict:
+    """The per-block record ``khipu_trace_block(n)`` serves: every
+    lifecycle phase the block traversed, in phase order, with raw span
+    intervals and cross-thread parent links intact."""
+    mine = spans_for_block(spans, number)
+    phases: Dict[str, List[dict]] = {}
+    other: List[dict] = []
+    for s in sorted(mine, key=lambda s: s.t0):
+        if s.name in LIFECYCLE_PHASES:
+            phases.setdefault(s.name, []).append(_span_json(s))
+        else:
+            other.append(_span_json(s))
+    present = [p for p in LIFECYCLE_PHASES if p in phases]
+    return {
+        "number": number,
+        "complete": all(p in phases for p in REQUIRED_PHASES),
+        "phaseOrder": present,
+        "phases": phases,
+        "otherSpans": other,
+        "threads": sorted(
+            {s.thread_name or str(s.tid) for s in mine}
+        ),
+    }
+
+
+def traced_blocks(spans: Sequence[Span]) -> List[int]:
+    """Every block number any span is tagged with (sorted)."""
+    nums = set()
+    for s in spans:
+        b = s.tags.get("block")
+        if b is not None:
+            nums.add(b)
+        lo = s.tags.get("block_lo")
+        if lo is not None:
+            nums.update(range(lo, s.tags.get("block_hi", lo) + 1))
+    return sorted(nums)
+
+
+# ------------------------------------------------------------- latency
+
+
+def phase_percentiles(spans: Sequence[Span]) -> Dict[str, dict]:
+    """p50/p90/p99 wall latency per span name."""
+    buckets: Dict[str, List[float]] = {}
+    for s in spans:
+        if s.t1 > s.t0:  # skip instant events
+            buckets.setdefault(s.name, []).append(s.duration)
+
+    def pct(sorted_vals: List[float], q: float) -> float:
+        return sorted_vals[int(q * (len(sorted_vals) - 1))]
+
+    out = {}
+    for name, vals in sorted(buckets.items()):
+        vals.sort()
+        out[name] = {
+            "count": len(vals),
+            "total_s": round(sum(vals), 6),
+            "p50_s": round(pct(vals, 0.50), 6),
+            "p90_s": round(pct(vals, 0.90), 6),
+            "p99_s": round(pct(vals, 0.99), 6),
+        }
+    return out
+
+
+def phase_breakdown(spans: Sequence[Span]) -> Dict[str, float]:
+    """Top-level wall seconds per canonical phase (driver + collector),
+    the split ``bench.py --trace`` prints next to blocks/s. Only
+    canonical-phase spans count — nested sub-spans (fused.dispatch
+    inside window.seal, etc.) would double-bill their parents."""
+    out: Dict[str, float] = {}
+    for s in spans:
+        if s.name in DRIVER_PHASES or s.name in COLLECTOR_PHASES:
+            out[s.name] = out.get(s.name, 0.0) + s.duration
+    return {k: round(v, 6) for k, v in out.items()}
+
+
+# ----------------------------------------------------------- occupancy
+
+
+def _merged_coverage(intervals: List[tuple], lo: float, hi: float) -> float:
+    """Seconds of [lo, hi) covered by the union of intervals."""
+    if hi <= lo:
+        return 0.0
+    cov = 0.0
+    end = lo
+    for a, b in sorted(intervals):
+        a, b = max(a, lo), min(b, hi)
+        if b <= end:
+            continue
+        cov += b - max(a, end)
+        end = b
+    return cov
+
+
+def occupancy(spans: Sequence[Span]) -> float:
+    """The gauge formula recomputed FROM SPANS: fraction of collector
+    busy time not spent with the driver blocked on it. Agreement with
+    ``PIPELINE_GAUGES['occupancy']`` within the log-call noise is the
+    tracing-accuracy acceptance check."""
+    busy = sum(s.duration for s in spans if s.name in COLLECTOR_PHASES)
+    stall = sum(s.duration for s in spans if s.name == PHASE_STALL)
+    if busy <= 0:
+        return 0.0
+    return max(0.0, min(1.0, (busy - stall) / busy))
+
+
+def occupancy_timeline(
+    spans: Sequence[Span], buckets: int = 60
+) -> List[dict]:
+    """Driver-busy / collector-busy coverage fraction per time bucket —
+    the picture that shows WHEN the pipeline ran dry, not just that it
+    averaged 0.7."""
+    driver = [
+        (s.t0, s.t1) for s in spans
+        if s.name in DRIVER_PHASES and s.t1 > s.t0
+    ]
+    collector = [
+        (s.t0, s.t1) for s in spans
+        if s.name in COLLECTOR_PHASES and s.t1 > s.t0
+    ]
+    both = driver + collector
+    if not both:
+        return []
+    t_lo = min(a for a, _ in both)
+    t_hi = max(b for _, b in both)
+    if t_hi <= t_lo:
+        return []
+    step = (t_hi - t_lo) / buckets
+    out = []
+    for i in range(buckets):
+        lo = t_lo + i * step
+        hi = lo + step
+        d = _merged_coverage(driver, lo, hi) / step
+        c = _merged_coverage(collector, lo, hi) / step
+        out.append({
+            "t": round(lo - tracer.epoch_perf, 6),
+            "driver": round(d, 4),
+            "collector": round(c, 4),
+        })
+    return out
+
+
+# ------------------------------------------------------ nesting checks
+
+
+def nesting_violations(spans: Sequence[Span],
+                       eps: float = 5e-4) -> List[str]:
+    """Causality/nesting audit, used by tests and the acceptance gate:
+
+    * same-thread child spans must lie INSIDE their parent's interval;
+    * cross-thread children must START no earlier than their parent
+      started (the collector's window.collect may outlive the driver's
+      seal span — FIFO handoff only orders the starts).
+
+    Returns human-readable violation strings (empty == correct).
+    """
+    by_id = {s.sid: s for s in spans}
+    bad = []
+    for s in spans:
+        if s.parent is None:
+            continue
+        p = by_id.get(s.parent)
+        if p is None:
+            continue  # parent rotated out of the ring
+        if s.tid == p.tid:
+            if s.t0 < p.t0 - eps or s.t1 > p.t1 + eps:
+                bad.append(
+                    f"span {s.name}#{s.sid} escapes same-thread parent "
+                    f"{p.name}#{p.sid}"
+                )
+        elif s.t0 < p.t0 - eps:
+            bad.append(
+                f"span {s.name}#{s.sid} starts before cross-thread "
+                f"parent {p.name}#{p.sid}"
+            )
+    return bad
+
+
+# -------------------------------------------------- compile-event log
+
+
+class CompileEventLog:
+    """Ring of fused-signature-cache events + monotonic counters.
+
+    ``record`` is called from trie/fused.py under the compile cache's
+    own lock, so the counter increments need no extra synchronization;
+    the deque append is GIL-atomic for concurrent READERS. Mirrored
+    into the tracer as instant events when tracing is enabled, so
+    compile storms show up inline on the perfetto timeline too."""
+
+    def __init__(self, capacity: int = 1024):
+        self._buf: deque = deque(maxlen=capacity)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def record(self, kind: str, key: str, seconds: float = 0.0) -> None:
+        if kind == "hit":
+            self.hits += 1
+        elif kind == "miss":
+            self.misses += 1
+        elif kind == "evict":
+            self.evictions += 1
+        self._buf.append({
+            "t": time.time(),
+            "kind": kind,
+            "signature": key,
+            **({"compile_s": round(seconds, 3)} if seconds else {}),
+        })
+        tracer.event("fused.compile", kind=kind, signature=key)
+
+    def snapshot(self) -> dict:
+        for _ in range(8):
+            try:
+                events = list(self._buf)
+                break
+            except RuntimeError:
+                continue
+        else:
+            events = []
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "events": events,
+        }
+
+    def reset(self) -> None:
+        self._buf.clear()
+        self.hits = self.misses = self.evictions = 0
+
+
+# THE process compile log (trie/fused.py writes, export.py reads)
+compile_log = CompileEventLog()

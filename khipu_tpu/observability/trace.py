@@ -1,0 +1,323 @@
+"""Flight-recorder span tracer: nestable spans into a lock-light ring.
+
+The deep pipeline (sync/replay.py) moved the block-commit hot path
+across three concurrency domains — driver thread, FIFO collector
+thread, remote cluster shards — so a stall surfaces only as a scalar
+gauge with no way to tell WHICH phase of WHICH window caused it. This
+module is the Dapper-style answer scoped to one process: every
+lifecycle phase runs inside a ``span(name, block=n)`` context that
+records wall time (perf_counter), thread CPU time (thread_time), the
+owning thread, free-form tags, and an explicit parent link that works
+ACROSS threads (the driver hands the collector its span token through
+the job closure — thread-local nesting alone cannot express that
+edge).
+
+Cost model — the whole design point:
+
+* DISABLED (the default): ``span(...)`` is one attribute load, one
+  branch, and returns the shared inert ``_NULL_SPAN`` singleton whose
+  ``__enter__``/``__exit__`` touch nothing. No allocation, no clock
+  read, no shared-state write — behavior (roots, stores, RNG-free
+  timings aside) is bit-exact identical to an uninstrumented build.
+* ENABLED: ~4 clock reads + one deque append per span. No lock on the
+  hot path: CPython's GIL makes ``deque.append`` (with ``maxlen`` —
+  drop-oldest) and ``itertools.count.__next__`` atomic, which is the
+  entire synchronization story ("lock-light"). Only ``snapshot()``
+  pays for consistency, retrying the O(n) copy if a concurrent append
+  mutates the deque mid-iteration.
+
+Overflow drops the OLDEST record silently; ``tracer.dropped`` exposes
+how many (exact whenever the writers are quiescent, off by at most the
+in-flight appends otherwise). Records are Span objects; readers treat
+them as immutable once ``t1`` is set.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "tracer",
+    "span",
+    "event",
+    "current_token",
+    "enable",
+    "disable",
+]
+
+
+class Span:
+    """One recorded phase: [t0, t1) wall interval on one thread.
+
+    ``token`` (the span id) is what crosses threads: capture it on the
+    producing thread, pass ``parent=token`` to the consuming thread's
+    span and the recorder/exporter reconstruct the causal edge.
+    """
+
+    __slots__ = (
+        "sid", "parent", "name", "tags", "tid", "thread_name",
+        "t0", "t1", "tt0", "tt1", "error", "_tracer",
+    )
+
+    def __init__(self, tracer_: "Tracer", name: str,
+                 parent: Optional[int], tags: Dict):
+        self._tracer = tracer_
+        self.name = name
+        self.tags = tags
+        self.sid = next(tracer_._ids)
+        self.parent = parent  # None -> resolved from the stack on enter
+        self.tid = 0
+        self.thread_name = ""
+        self.t0 = self.t1 = 0.0
+        self.tt0 = self.tt1 = 0.0
+        self.error = False
+
+    # ----------------------------------------------------- context mgr
+
+    def __enter__(self) -> "Span":
+        t = self._tracer
+        cur = threading.current_thread()
+        self.tid = cur.ident or 0
+        self.thread_name = cur.name
+        if self.parent is None:
+            stack = t._stack()
+            if stack:
+                self.parent = stack[-1].sid
+        t._stack().append(self)
+        self.tt0 = time.thread_time()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.t1 = time.perf_counter()
+        self.tt1 = time.thread_time()
+        if exc_type is not None:
+            self.error = True
+        t = self._tracer
+        stack = t._stack()
+        # pop OUR frame (tolerate a torn stack from generator misuse)
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:
+            stack.remove(self)
+        t._record(self)
+        return False
+
+    # ----------------------------------------------------------- sugar
+
+    @property
+    def token(self) -> int:
+        """Opaque id to hand another thread as ``parent=``."""
+        return self.sid
+
+    @property
+    def duration(self) -> float:
+        return max(0.0, self.t1 - self.t0)
+
+    @property
+    def cpu(self) -> float:
+        """Thread CPU seconds inside the span (blocked time excluded)."""
+        return max(0.0, self.tt1 - self.tt0)
+
+    def set_tag(self, key: str, value) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"<Span {self.name} #{self.sid} parent={self.parent} "
+            f"{self.duration * 1e3:.2f}ms tags={self.tags}>"
+        )
+
+
+class _NullSpan:
+    """The inert singleton every ``span()`` call returns while tracing
+    is disabled: enter/exit/set_tag are no-ops, ``token`` is None, and
+    no shared state is touched — the zero-cost-when-off guarantee."""
+
+    __slots__ = ()
+    token = None
+    parent = None
+    duration = 0.0
+    cpu = 0.0
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+    def set_tag(self, key: str, value) -> "_NullSpan":
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    DEFAULT_CAPACITY = 65536
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.enabled = False
+        self.capacity = capacity
+        self._buf: deque = deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+        self._seq = itertools.count(1)  # appended-record counter
+        self._last_seq = 0
+        self._local = threading.local()
+        # perf_counter <-> wall-clock anchor for absolute timestamps
+        self.epoch_perf = time.perf_counter()
+        self.epoch_wall = time.time()
+
+    # ---------------------------------------------------------- control
+
+    def enable(self, capacity: Optional[int] = None) -> None:
+        """(Re)start recording with an empty ring. Idempotent re-enable
+        with the same capacity keeps the existing buffer."""
+        if capacity is not None and capacity != self.capacity:
+            self.capacity = capacity
+            self._buf = deque(maxlen=capacity)
+            self._seq = itertools.count(1)
+            self._last_seq = 0
+        self.epoch_perf = time.perf_counter()
+        self.epoch_wall = time.time()
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        """Drop every record and the drop counter; keep enabled state."""
+        self._buf = deque(maxlen=self.capacity)
+        self._seq = itertools.count(1)
+        self._last_seq = 0
+        self.epoch_perf = time.perf_counter()
+        self.epoch_wall = time.time()
+
+    # ------------------------------------------------------------ spans
+
+    def span(self, name: str, parent: Optional[int] = None,
+             **tags) -> "Span | _NullSpan":
+        if not self.enabled:
+            return _NULL_SPAN
+        return Span(self, name, parent, tags)
+
+    def event(self, name: str, parent: Optional[int] = None,
+              **tags) -> None:
+        """Instant (zero-duration) record — compile events, failovers."""
+        if not self.enabled:
+            return
+        s = Span(self, name, parent, tags)
+        cur = threading.current_thread()
+        s.tid = cur.ident or 0
+        s.thread_name = cur.name
+        if s.parent is None:
+            stack = self._stack()
+            if stack:
+                s.parent = stack[-1].sid
+        s.t0 = s.t1 = time.perf_counter()
+        s.tt0 = s.tt1 = time.thread_time()
+        self._record(s)
+
+    def current_token(self) -> Optional[int]:
+        """Span id of the innermost open span on THIS thread (None when
+        disabled or outside any span) — the value to ship across a
+        queue as ``parent=`` for a cross-thread child."""
+        if not self.enabled:
+            return None
+        stack = getattr(self._local, "stack", None)
+        return stack[-1].sid if stack else None
+
+    # --------------------------------------------------------- plumbing
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _record(self, s: Span) -> None:
+        # GIL-atomic append; maxlen makes it drop-oldest
+        self._buf.append(s)
+        self._last_seq = next(self._seq)
+
+    @property
+    def dropped(self) -> int:
+        """Records lost to ring overflow (drop-oldest)."""
+        return max(0, self._last_seq - self.capacity)
+
+    @property
+    def recorded(self) -> int:
+        return self._last_seq
+
+    def snapshot(self) -> List[Span]:
+        """Consistent copy of the ring, oldest first. Lock-free writers
+        may mutate the deque mid-copy; retry until a clean pass."""
+        for _ in range(64):
+            try:
+                return list(self._buf)
+            except RuntimeError:  # deque mutated during iteration
+                continue
+        # pathological write pressure: degrade to an approximate copy
+        return [s for s in tuple(self._buf)]
+
+    def to_wall(self, t_perf: float) -> float:
+        """Map a perf_counter stamp to absolute unix seconds."""
+        return self.epoch_wall + (t_perf - self.epoch_perf)
+
+
+# THE process tracer: hot paths import the module functions below,
+# which bind to this instance (tests may swap in their own Tracer via
+# ``tracer.enable(...)`` / ``reset`` — the instance itself is stable).
+tracer = Tracer()
+
+
+def span(name: str, parent: Optional[int] = None, **tags):
+    """``with span("window.seal", block=n) as s: ...`` — the module-
+    level entry the instrumentation seams use. Disabled: returns the
+    shared inert singleton (no allocation)."""
+    if not tracer.enabled:
+        return _NULL_SPAN
+    return Span(tracer, name, parent, tags)
+
+
+def event(name: str, parent: Optional[int] = None, **tags) -> None:
+    tracer.event(name, parent, **tags)
+
+
+def current_token() -> Optional[int]:
+    return tracer.current_token()
+
+
+def enable(capacity: Optional[int] = None) -> None:
+    tracer.enable(capacity)
+
+
+def disable() -> None:
+    tracer.disable()
+
+
+def apply_config(cfg) -> None:
+    """Wire an ObservabilityConfig (config.py): enable/disable the
+    process tracer and size the fused compile cache. Idempotent — safe
+    to call from every driver/service constructor."""
+    if cfg is None:
+        return
+    if cfg.enabled and not tracer.enabled:
+        tracer.enable(cfg.ring_capacity)
+    elif not cfg.enabled and tracer.enabled:
+        # an explicit disabled config does NOT stomp a manual enable()
+        # (bench --trace flips the tracer on over a default config)
+        pass
+    try:
+        from khipu_tpu.trie.fused import compile_cache
+
+        compile_cache.set_capacity(cfg.compile_cache_capacity)
+    except Exception:
+        pass

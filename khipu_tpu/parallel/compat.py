@@ -1,0 +1,58 @@
+"""jax API-drift shims for the parallel package.
+
+``shard_map`` has lived at three addresses across jax releases —
+``jax.shard_map`` (new public home), ``jax.sharding.shard_map``
+(transitional), and ``jax.experimental.shard_map.shard_map`` (the
+original) — and renamed its replication-check kwarg from ``check_rep``
+to ``check_vma`` along the way. The parallel modules import THIS
+wrapper, which resolves whichever implementation the installed jax
+provides and translates the kwarg, so the sharded bulk-build and the
+multi-chip fused finalize run unmodified on any of those versions.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+
+def _resolve_shard_map():
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    try:
+        from jax.sharding import shard_map as fn  # type: ignore
+
+        return fn
+    except ImportError:
+        pass
+    from jax.experimental.shard_map import shard_map as fn  # noqa: F811
+
+    return fn
+
+
+_IMPL = _resolve_shard_map()
+_IMPL_PARAMS = frozenset(inspect.signature(_IMPL).parameters)
+_UNSET = object()
+
+
+def shard_map(f=None, *, check_vma=_UNSET, check_rep=_UNSET, **kwargs):
+    """Version-portable ``shard_map``.
+
+    Accepts either spelling of the replication-check kwarg and forwards
+    the one the installed implementation understands (dropping it if
+    the implementation predates both). Usable directly or through
+    ``functools.partial`` as a decorator, like the real thing.
+    """
+    flag = check_vma if check_vma is not _UNSET else check_rep
+    if flag is not _UNSET:
+        if "check_vma" in _IMPL_PARAMS:
+            kwargs["check_vma"] = flag
+        elif "check_rep" in _IMPL_PARAMS:
+            kwargs["check_rep"] = flag
+    if f is None:
+        import functools
+
+        return functools.partial(shard_map, **kwargs)
+    return _IMPL(f, **kwargs)

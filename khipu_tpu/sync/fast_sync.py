@@ -27,6 +27,7 @@ from khipu_tpu.domain.account import (
     EMPTY_STORAGE_ROOT,
     Account,
 )
+from khipu_tpu.observability.trace import span
 
 # Typed node hashes (sync/package.scala:21-42).
 STATE_NODE = 0  # account-trie MPT node
@@ -156,10 +157,14 @@ class StateSyncer:
         self.mirror = mirror
 
     def _verify(self, hashes: List[bytes], values: List[bytes]) -> List[bool]:
-        if self.hasher is None:
-            return [keccak256(v) == h for h, v in zip(hashes, values)]
-        digests = self.hasher(values)
-        return [d == h for d, h in zip(digests, hashes)]
+        with span(
+            "fastsync.verify", nodes=len(hashes),
+            device=self.hasher is not None,
+        ):
+            if self.hasher is None:
+                return [keccak256(v) == h for h, v in zip(hashes, values)]
+            digests = self.hasher(values)
+            return [d == h for d, h in zip(digests, hashes)]
 
     def start(self, target_root: bytes) -> SyncState:
         """Begin (or resume) syncing toward target_root; runs to
@@ -177,7 +182,9 @@ class StateSyncer:
             batch = state.pending[: self.batch_size]
             state.pending = state.pending[self.batch_size :]
             want = [h for _, h in batch]
-            got = self.fetch(want)
+            with span("fastsync.fetch", batch=batches_done,
+                      nodes=len(want)):
+                got = self.fetch(want)
             missing: List[Tuple[int, bytes]] = []
             hashes, values, kinds = [], [], []
             for kind, h in batch:

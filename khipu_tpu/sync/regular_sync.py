@@ -47,6 +47,7 @@ from khipu_tpu.network.messages import (
     encode_transactions,
 )
 from khipu_tpu.network.peer import Peer, PeerError, PeerManager
+from khipu_tpu.observability.trace import span
 from khipu_tpu.sync.replay import ReplayDriver
 from khipu_tpu.trie.mpt import MPTNodeMissingException
 from khipu_tpu.validators.roots import ommers_hash, transactions_root
@@ -246,33 +247,38 @@ class RegularSyncService:
         failover + breakers, values pre-verified by the client) is
         consulted first; the announcing peer is the fallback when no
         shard holds the node."""
-        if self.cluster is not None:
-            try:
-                got = self.cluster.fetch([node_hash])
-            except Exception:
-                got = {}
-            blob = got.get(node_hash)
-            if blob is not None and keccak256(blob) == node_hash:
-                s = self.blockchain.storages
-                s.account_node_storage.put(node_hash, blob)
-                s.storage_node_storage.put(node_hash, blob)
-                self.healed_nodes += 1
-                self.cluster_healed += 1
-                return
-        body = peer.request(
-            ETH_OFFSET + GET_NODE_DATA,
-            [node_hash],
-            ETH_OFFSET + NODE_DATA,
-            timeout=self.timeout,
-        )
-        for blob in body:
-            if keccak256(blob) == node_hash:
-                s = self.blockchain.storages
-                s.account_node_storage.put(node_hash, blob)
-                s.storage_node_storage.put(node_hash, blob)
-                self.healed_nodes += 1
-                return
-        raise PeerError(f"peer could not heal node {node_hash.hex()[:16]}")
+        with span("sync.heal", node=node_hash) as heal_sp:
+            if self.cluster is not None:
+                try:
+                    got = self.cluster.fetch([node_hash])
+                except Exception:
+                    got = {}
+                blob = got.get(node_hash)
+                if blob is not None and keccak256(blob) == node_hash:
+                    s = self.blockchain.storages
+                    s.account_node_storage.put(node_hash, blob)
+                    s.storage_node_storage.put(node_hash, blob)
+                    self.healed_nodes += 1
+                    self.cluster_healed += 1
+                    heal_sp.set_tag("source", "cluster")
+                    return
+            body = peer.request(
+                ETH_OFFSET + GET_NODE_DATA,
+                [node_hash],
+                ETH_OFFSET + NODE_DATA,
+                timeout=self.timeout,
+            )
+            for blob in body:
+                if keccak256(blob) == node_hash:
+                    s = self.blockchain.storages
+                    s.account_node_storage.put(node_hash, blob)
+                    s.storage_node_storage.put(node_hash, blob)
+                    self.healed_nodes += 1
+                    heal_sp.set_tag("source", "peer")
+                    return
+            raise PeerError(
+                f"peer could not heal node {node_hash.hex()[:16]}"
+            )
 
     # -------------------------------------------------------------- steps
 
@@ -398,19 +404,21 @@ class RegularSyncService:
                     self.imported += done
                     blocks = blocks[done:]
             for block in blocks:
-                for attempt in range(3):
-                    try:
-                        self._driver._execute_and_insert(
-                            block, _NullStats()
+                with span("import", block=block.header.number,
+                          txs=len(block.body.transactions)):
+                    for attempt in range(3):
+                        try:
+                            self._driver._execute_and_insert(
+                                block, _NullStats()
+                            )
+                            break
+                        except MPTNodeMissingException as e:
+                            self._heal_missing_node(peer, e.hash)
+                    else:
+                        raise SyncAborted(
+                            f"block {block.header.number} kept failing "
+                            "after heals"
                         )
-                        break
-                    except MPTNodeMissingException as e:
-                        self._heal_missing_node(peer, e.hash)
-                else:
-                    raise SyncAborted(
-                        f"block {block.header.number} kept failing "
-                        "after heals"
-                    )
                 if self.txpool is not None:
                     self.txpool.remove_mined(block.body.transactions)
                 imported += 1
@@ -529,10 +537,12 @@ class RegularSyncService:
             if number != self.blockchain.best_block_number + 1:
                 continue  # the pull round handles gaps/branches
             src = source if source is not None and source.alive else peer
-            headers = self._request_headers(src, number, 1)
-            if not headers or headers[0].hash != block_hash:
-                continue
-            blocks = self._fetch_blocks(src, headers)
+            with span("announce", block=number,
+                      from_announcer=source is not None):
+                headers = self._request_headers(src, number, 1)
+                if not headers or headers[0].hash != block_hash:
+                    continue
+                blocks = self._fetch_blocks(src, headers)
             if not self._import_lock.acquire(blocking=False):
                 # a push import holds the lock: give the unprocessed
                 # tail (this announce included) back to the backlog so
@@ -578,7 +588,8 @@ class RegularSyncService:
         ):
             return  # side branch: the pull loop's TD rule decides
         try:
-            self._driver._execute_and_insert(block, _NullStats())
+            with span("import", block=block.header.number, pushed=True):
+                self._driver._execute_and_insert(block, _NullStats())
             self.imported += 1
             if self.txpool is not None:
                 self.txpool.remove_mined(block.body.transactions)

@@ -23,6 +23,7 @@ from khipu_tpu.domain.blockchain import Blockchain
 from khipu_tpu.domain.difficulty import calc_difficulty
 from khipu_tpu.domain.transaction import recover_senders
 from khipu_tpu.ledger.ledger import execute_block
+from khipu_tpu.observability.trace import apply_config, span
 from khipu_tpu.validators.validators import (
     BlockHeaderValidator,
     BlockValidator,
@@ -198,6 +199,7 @@ class ReplayDriver:
     ):
         self.blockchain = blockchain
         self.config = config
+        apply_config(config.observability)
         self.log = log
         self.header_validator = BlockHeaderValidator(
             config.blockchain,
@@ -302,36 +304,47 @@ class ReplayDriver:
         epoch = self.session_epoch_blocks
         blocks_since_reset = 0
 
-        def make_collect_job(cm: WindowCommitter, job, results):
-            # runs ON THE COLLECTOR THREAD, strictly FIFO
+        def make_collect_job(cm: WindowCommitter, job, results, seal_tok):
+            # runs ON THE COLLECTOR THREAD, strictly FIFO. ``seal_tok``
+            # (the driver's window.seal span id) rides the closure across
+            # the queue so the trace links the collector's spans to the
+            # seal that produced them (the cross-thread parent edge —
+            # flow arrows in the Chrome dump)
+            lo, hi = results[0][0].number, results[-1][0].number
+
             def run():
                 t0 = time.perf_counter()
-                cm.collect(job)  # raises WindowMismatch on divergence
+                with span("window.collect", parent=seal_tok,
+                          block_lo=lo, block_hi=hi):
+                    cm.collect(job)  # raises WindowMismatch on divergence
                 t1 = time.perf_counter()
                 ph["collect_bg"] += t1 - t0
-                for block, result in results:
-                    td = (
-                        self.blockchain.get_total_difficulty(
-                            block.number - 1
+                with span("window.persist", parent=seal_tok,
+                          block_lo=lo, block_hi=hi, blocks=len(results)):
+                    for block, result in results:
+                        td = (
+                            self.blockchain.get_total_difficulty(
+                                block.number - 1
+                            )
+                            or 0
+                        ) + block.header.difficulty
+                        # world=None: the window already persisted the
+                        # nodes
+                        self.blockchain.save_block(
+                            block, result.receipts, td, world=None
                         )
-                        or 0
-                    ) + block.header.difficulty
-                    # world=None: the window already persisted the nodes
-                    self.blockchain.save_block(
-                        block, result.receipts, td, world=None
-                    )
-                    stats.blocks += 1
-                    stats.txs += result.stats.tx_count
-                    stats.gas += result.gas_used
-                    stats.parallel_txs += result.stats.parallel_count
-                    stats.conflicts += result.stats.conflict_count
+                        stats.blocks += 1
+                        stats.txs += result.stats.tx_count
+                        stats.gas += result.gas_used
+                        stats.parallel_txs += result.stats.parallel_count
+                        stats.conflicts += result.stats.conflict_count
+                    if self.log is not None:
+                        self.log(
+                            f"Committed window [{lo}..{hi}] "
+                            f"({len(results)} blocks) in one batched "
+                            "device pass"
+                        )
                 ph["save_bg"] += time.perf_counter() - t1
-                if self.log is not None:
-                    self.log(
-                        f"Committed window [{results[0][0].number}.."
-                        f"{results[-1][0].number}] ({len(results)} "
-                        "blocks) in one batched device pass"
-                    )
 
             return run
 
@@ -342,43 +355,51 @@ class ReplayDriver:
         try:
             for block in itertools.chain((first,), blocks):
                 header = block.header
-                t0 = time.perf_counter()
-                # batch-recover + cache every sender in one native call
-                recover_senders(block.body.transactions)
-                ph["senders"] += time.perf_counter() - t0
-                t0 = time.perf_counter()
-                if self.validate_headers:
-                    self.header_validator.validate(header, prev)
-                BlockValidator.validate_body(block)
-                OmmersValidator.validate(
-                    self.blockchain, block,
-                    header_lookup=window_headers_full.get,
-                    block_lookup=window_blocks.get,
-                    header_validator=(
-                        self.header_validator
-                        if self.validate_headers else None
-                    ),
-                )
-                config = for_block(header.number, self.config.blockchain)
-                if not config.byzantium:
-                    raise ValueError(
-                        "window commits need Byzantium receipts "
-                        "(pre-Byzantium receipts embed per-tx roots)"
+                with span(
+                    "window.build",
+                    block=header.number,
+                    txs=len(block.body.transactions),
+                ):
+                    t0 = time.perf_counter()
+                    # batch-recover + cache every sender in one native
+                    # call
+                    recover_senders(block.body.transactions)
+                    ph["senders"] += time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    if self.validate_headers:
+                        self.header_validator.validate(header, prev)
+                    BlockValidator.validate_body(block)
+                    OmmersValidator.validate(
+                        self.blockchain, block,
+                        header_lookup=window_headers_full.get,
+                        block_lookup=window_blocks.get,
+                        header_validator=(
+                            self.header_validator
+                            if self.validate_headers else None
+                        ),
                     )
-                ph["validate"] += time.perf_counter() - t0
-                t0 = time.perf_counter()
-                result = execute_block(
-                    block,
-                    b"",  # the open session IS the parent state
-                    committer.make_world,
-                    self.config,
-                    validate=True,
-                    check_root=False,  # deferred to window finalize
-                )
-                ph["execute"] += time.perf_counter() - t0
-                t0 = time.perf_counter()
-                committer.commit_block(result.world, header)
-                ph["commit"] += time.perf_counter() - t0
+                    config = for_block(
+                        header.number, self.config.blockchain
+                    )
+                    if not config.byzantium:
+                        raise ValueError(
+                            "window commits need Byzantium receipts "
+                            "(pre-Byzantium receipts embed per-tx roots)"
+                        )
+                    ph["validate"] += time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    result = execute_block(
+                        block,
+                        b"",  # the open session IS the parent state
+                        committer.make_world,
+                        self.config,
+                        validate=True,
+                        check_root=False,  # deferred to window finalize
+                    )
+                    ph["execute"] += time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    committer.commit_block(result.world, header)
+                    ph["commit"] += time.perf_counter() - t0
                 window_headers[header.number] = header.hash
                 window_headers_full[header.number] = header
                 window_blocks[header.number] = block
@@ -390,17 +411,32 @@ class ReplayDriver:
                     # input tiles); the only wait is submit backpressure
                     # once pipeline_depth windows are queued
                     blocks_since_reset += len(results_cur)
+                    lo = results_cur[0][0].number
+                    hi = results_cur[-1][0].number
                     t0 = time.perf_counter()
-                    job = committer.seal()
+                    with span(
+                        "window.seal", block_lo=lo, block_hi=hi
+                    ) as seal_sp:
+                        job = committer.seal()
                     ph["seal"] += time.perf_counter() - t0
-                    ph["collect"] += collector.submit(
-                        make_collect_job(committer, job, results_cur)
-                    )
+                    with span(
+                        "pipeline.stall", block_lo=lo, block_hi=hi,
+                        kind="submit",
+                    ):
+                        stalled = collector.submit(
+                            make_collect_job(
+                                committer, job, results_cur,
+                                seal_sp.token,
+                            )
+                        )
+                    ph["collect"] += stalled
                     results_cur = []
                     if blocks_since_reset >= epoch:
                         # drain the pipeline, then restart the session from
                         # the last validated root (memory bound)
-                        ph["collect"] += collector.drain()
+                        with span("pipeline.stall", kind="epoch-drain"):
+                            stalled = collector.drain()
+                        ph["collect"] += stalled
                         committer = make_committer(prev.state_root)
                         blocks_since_reset = 0
                         # header/body maps: ommers reach back 6 ancestors,
@@ -413,13 +449,27 @@ class ReplayDriver:
                             for n in sorted(d)[:-keep]:
                                 del d[n]
             if results_cur:
+                lo = results_cur[0][0].number
+                hi = results_cur[-1][0].number
                 t0 = time.perf_counter()
-                job = committer.seal()
+                with span(
+                    "window.seal", block_lo=lo, block_hi=hi
+                ) as seal_sp:
+                    job = committer.seal()
                 ph["seal"] += time.perf_counter() - t0
-                ph["collect"] += collector.submit(
-                    make_collect_job(committer, job, results_cur)
-                )
-            ph["collect"] += collector.drain()
+                with span(
+                    "pipeline.stall", block_lo=lo, block_hi=hi,
+                    kind="submit",
+                ):
+                    stalled = collector.submit(
+                        make_collect_job(
+                            committer, job, results_cur, seal_sp.token
+                        )
+                    )
+                ph["collect"] += stalled
+            with span("pipeline.stall", kind="final-drain"):
+                stalled = collector.drain()
+            ph["collect"] += stalled
         except BaseException:
             # a driver-side failure (validation, execution, or a
             # re-raised collector failure) aborts the pipeline:

@@ -35,11 +35,15 @@ window.
 
 from __future__ import annotations
 
-import functools
+import threading
+import time
+from collections import OrderedDict
 from typing import Dict, List, Tuple
 
 import numpy as np
 
+from khipu_tpu.observability.recorder import compile_log
+from khipu_tpu.observability.trace import span as _span
 from khipu_tpu.ops.keccak_jnp import RATE
 
 MAX_DEPTH = 64  # DAG deeper than this falls back to the level loop
@@ -79,9 +83,76 @@ def _pow2(n: int, floor: int = 1) -> int:
     return v
 
 
-@functools.lru_cache(maxsize=64)
-def _build_fused(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
-                 use_jnp: bool, ext_rows: int = 0):
+class _CompileCache:
+    """Bounded LRU over compiled fixpoint programs, keyed by the full
+    shape signature (per-class (nblocks, nrows, nsubs), rounds, backend,
+    ext-tile rows). Replaces the blind ``functools.lru_cache``: every
+    access lands in the observability compile-event log (hit /
+    miss+compile-seconds / eviction — recorder.compile_log), which is
+    what ROADMAP's "watch compile-cache pressure on very long sessions"
+    actually watches. Coarse pow-2 bucketing upstream keeps steady
+    state at a handful of signatures; a session whose organic shapes
+    churn past ``capacity`` now evicts LRU (and says so) instead of
+    growing without bound."""
+
+    def __init__(self, builder, capacity: int = 64):
+        self._builder = builder
+        self._capacity = max(1, capacity)
+        self._od: "OrderedDict[tuple, object]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _label(key: tuple) -> str:
+        sig, rounds, use_jnp, ext_rows = key
+        classes = ",".join(f"{nb}x{nr}/{ns}" for nb, nr, ns in sig)
+        return (
+            f"classes=[{classes}] rounds={rounds} "
+            f"backend={'jnp' if use_jnp else 'pallas'} ext={ext_rows}"
+        )
+
+    def __call__(self, sig, rounds, use_jnp, ext_rows=0):
+        key = (sig, rounds, use_jnp, ext_rows)
+        with self._lock:
+            run = self._od.get(key)
+            if run is not None:
+                self._od.move_to_end(key)
+                compile_log.record("hit", self._label(key))
+                return run
+        # build OUTSIDE the lock: an XLA compile takes seconds and must
+        # not block a concurrent hit; a racing duplicate compile is
+        # wasted work, not an error (first insert wins)
+        t0 = time.perf_counter()
+        with _span("fused.compile", signature=self._label(key)):
+            run = self._builder(sig, rounds, use_jnp, ext_rows)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            if key in self._od:
+                return self._od[key]
+            compile_log.record("miss", self._label(key), dt)
+            self._od[key] = run
+            while len(self._od) > self._capacity:
+                old_key, _ = self._od.popitem(last=False)
+                compile_log.record("evict", self._label(old_key))
+        return run
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._capacity = max(1, capacity)
+            while len(self._od) > self._capacity:
+                old_key, _ = self._od.popitem(last=False)
+                compile_log.record("evict", self._label(old_key))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._od.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"size": len(self._od), "capacity": self._capacity}
+
+
+def _build_fused_impl(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
+                      use_jnp: bool, ext_rows: int = 0):
     """Compile the fixpoint program for a shape signature.
 
     sig: per class (nblocks, nrows, nsubs), nrows % TILE == 0.
@@ -156,6 +227,13 @@ def _build_fused(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
     return run
 
 
+# the bounded, instrumented successor of `lru_cache(maxsize=64)`;
+# capacity follows ObservabilityConfig.compile_cache_capacity
+# (observability.trace.apply_config calls set_capacity)
+_build_fused = _CompileCache(_build_fused_impl)
+compile_cache = _build_fused  # public handle: stats() / set_capacity()
+
+
 class FusedJob:
     """In-flight fused finalize: the device dispatch has been issued
     (asynchronously — JAX returns before the TPU finishes) but digests
@@ -184,21 +262,22 @@ class FusedJob:
             return {}
         import jax
 
-        d = np.asarray(jax.device_get(self.digests))
-        # ONE device fetch, ONE bytes copy, then pure slicing — the
-        # per-row `d[i].tobytes()` loop paid a numpy indexing round per
-        # node and dominated the collect phase (BENCH_r05)
-        blob = d.tobytes()
-        out: Dict[bytes, bytes] = {}
-        for rows, base in self.class_rows:
-            o = base * 32
-            out.update(
-                zip(
-                    rows,
-                    (blob[o + 32 * r : o + 32 * r + 32]
-                     for r in range(len(rows))),
+        with _span("fused.collect", rows=int(self.digests.shape[0])):
+            d = np.asarray(jax.device_get(self.digests))
+            # ONE device fetch, ONE bytes copy, then pure slicing — the
+            # per-row `d[i].tobytes()` loop paid a numpy indexing round
+            # per node and dominated the collect phase (BENCH_r05)
+            blob = d.tobytes()
+            out: Dict[bytes, bytes] = {}
+            for rows, base in self.class_rows:
+                o = base * 32
+                out.update(
+                    zip(
+                        rows,
+                        (blob[o + 32 * r : o + 32 * r + 32]
+                         for r in range(len(rows))),
+                    )
                 )
-            )
         self._mapping = out
         return out
 
@@ -243,6 +322,15 @@ def fused_submit(
     a window can be sealed and dispatched while its predecessor is
     still hashing (the seal/collect barrier removal).
     """
+    with _span(
+        "fused.dispatch",
+        nodes=len(to_resolve),
+        ext_rows=int(ext[0].shape[0]) if ext is not None else 0,
+    ):
+        return _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext)
+
+
+def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext) -> FusedJob:
     if not to_resolve:
         return FusedJob(None, [])
     if depth is None:

@@ -1,0 +1,390 @@
+"""Flight-recorder tests (khipu_tpu/observability/): zero-cost-when-
+off, ring-overflow accounting, cross-thread lifecycle linkage through
+the deep pipeline, occupancy agreement with the live gauge, chrome
+trace_event export, the bounded fused compile cache, and the
+bench --trace per-phase breakdown."""
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.config import ObservabilityConfig, SyncConfig, fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.observability import export, recorder
+from khipu_tpu.observability.trace import (
+    Tracer,
+    _NULL_SPAN,
+    span,
+    tracer,
+)
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.sync.replay import ReplayDriver
+
+CFG = fixture_config(chain_id=1)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(4)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ETH = 10**18
+MINER = b"\xaa" * 20
+
+
+def tx(i, nonce, to, value):
+    return sign_transaction(
+        Transaction(nonce, 10**9, 21_000, to, value), KEYS[i], chain_id=1
+    )
+
+
+def pipeline_cfg(w=2, depth=2):
+    return dataclasses.replace(
+        CFG,
+        sync=SyncConfig(
+            parallel_tx=True, commit_window_blocks=w, pipeline_depth=depth
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """5 transfer blocks (windowed pipeline shape, no device needed)."""
+    builder = ChainBuilder(
+        Blockchain(Storages(), CFG), CFG,
+        GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}),
+    )
+    blocks = []
+    nonces = [0] * 4
+    for n in range(5):
+        txs = []
+        for j in range(3):
+            i = j % 4
+            txs.append(tx(i, nonces[i], ADDRS[(i + 1) % 4], 100 + n))
+            nonces[i] += 1
+        blocks.append(builder.add_block(txs, coinbase=MINER))
+    return blocks
+
+
+def _fresh_chain(cfg):
+    bc = Blockchain(Storages(), cfg)
+    bc.load_genesis(GenesisSpec(alloc={a: 1000 * ETH for a in ADDRS}))
+    return bc
+
+
+@pytest.fixture(scope="module")
+def traced_replay(chain):
+    """One pipelined replay with the recorder ON; yields
+    (stats, spans snapshot). Module-scoped: several tests interrogate
+    the same trace. Restores the disabled default afterwards."""
+    tracer.enable()
+    tracer.reset()
+    try:
+        cfg = pipeline_cfg(w=2, depth=2)
+        bc = _fresh_chain(cfg)
+        stats = ReplayDriver(bc, cfg).replay(chain)
+        spans = tracer.snapshot()
+        yield stats, spans
+    finally:
+        tracer.disable()
+        tracer.reset()
+
+
+# ------------------------------------------------------ disabled mode
+
+
+class TestDisabledMode:
+    def test_span_is_inert_singleton(self):
+        assert not tracer.enabled
+        s = span("anything", block=7)
+        assert s is _NULL_SPAN
+        assert s is span("other")  # shared: no allocation per call
+        assert s.token is None
+        before = tracer.recorded
+        with s as inner:
+            inner.set_tag("k", "v")  # all no-ops
+        assert tracer.recorded == before
+        assert tracer.snapshot() == []
+
+    def test_disabled_replay_roots_bit_exact(self, chain):
+        """A traced replay and an untraced replay of the same blocks
+        land on byte-identical chain heads (replay validates every
+        window root, so any tracing-induced divergence would raise)."""
+        cfg = pipeline_cfg(w=2, depth=2)
+        bc_off = _fresh_chain(cfg)
+        ReplayDriver(bc_off, cfg).replay(chain)
+        tracer.enable()
+        tracer.reset()
+        try:
+            bc_on = _fresh_chain(cfg)
+            ReplayDriver(bc_on, cfg).replay(chain)
+        finally:
+            tracer.disable()
+            tracer.reset()
+        h_off = bc_off.get_header_by_number(5)
+        h_on = bc_on.get_header_by_number(5)
+        assert h_off.hash == h_on.hash == chain[-1].hash
+        assert h_off.state_root == h_on.state_root
+
+    def test_config_enables_tracer(self, chain):
+        """ObservabilityConfig(enabled=True) on the driver's config
+        flips the process tracer on at construction."""
+        cfg = dataclasses.replace(
+            pipeline_cfg(),
+            observability=ObservabilityConfig(
+                enabled=True, ring_capacity=4096
+            ),
+        )
+        assert not tracer.enabled
+        try:
+            ReplayDriver(_fresh_chain(cfg), cfg)
+            assert tracer.enabled
+            assert tracer.capacity == 4096
+        finally:
+            tracer.disable()
+            tracer.reset()
+
+
+# ------------------------------------------------------- ring buffer
+
+
+class TestRing:
+    def test_overflow_drop_oldest_and_counter(self):
+        t = Tracer(capacity=8)
+        t.enable()
+        for i in range(20):
+            t.event("e", i=i)
+        assert t.recorded == 20
+        assert t.dropped == 12
+        kept = t.snapshot()
+        assert [s.tags["i"] for s in kept] == list(range(12, 20))
+
+    def test_reset_clears_drop_counter(self):
+        t = Tracer(capacity=4)
+        t.enable()
+        for i in range(9):
+            t.event("e", i=i)
+        assert t.dropped == 5
+        t.reset()
+        assert t.dropped == 0 and t.snapshot() == []
+        t.event("e", i=0)
+        assert t.recorded == 1 and t.dropped == 0
+
+    def test_concurrent_appends_lock_free(self):
+        """8 writer threads into a small ring: no exception, exact
+        recorded count, dropped = recorded - capacity."""
+        t = Tracer(capacity=64)
+        t.enable()
+
+        def burst():
+            for i in range(500):
+                with t.span("w", i=i):
+                    pass
+
+        threads = [threading.Thread(target=burst) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert t.recorded == 4000
+        assert t.dropped == 4000 - 64
+        assert len(t.snapshot()) == 64
+
+
+# ------------------------------------- lifecycle across the pipeline
+
+
+class TestLifecycle:
+    def test_cross_thread_parent_linkage(self, traced_replay):
+        """window.collect / window.persist run on the collector thread
+        but carry the DRIVER's seal-span token as parent — the explicit
+        cross-thread edge thread-local nesting cannot express."""
+        _, spans = traced_replay
+        by_id = {s.sid: s for s in spans}
+        collects = [s for s in spans if s.name == recorder.PHASE_COLLECT]
+        assert collects, "no window.collect spans recorded"
+        for c in collects:
+            parent = by_id[c.parent]
+            assert parent.name == recorder.PHASE_SEAL
+            assert parent.tid != c.tid, "collect ran on the driver?"
+            assert parent.tags["block_lo"] == c.tags["block_lo"]
+        persists = [s for s in spans if s.name == recorder.PHASE_PERSIST]
+        assert persists
+        assert all(
+            by_id[p.parent].name == recorder.PHASE_SEAL for p in persists
+        )
+
+    def test_no_nesting_violations(self, traced_replay):
+        _, spans = traced_replay
+        assert recorder.nesting_violations(spans) == []
+
+    def test_trace_block_lifecycle_complete(self, traced_replay):
+        """khipu_trace_block(n)'s record: every required phase present,
+        in pipeline order, spanning both threads."""
+        _, spans = traced_replay
+        for n in (1, 3, 5):
+            rec = recorder.lifecycle(spans, n)
+            assert rec["complete"], rec["phaseOrder"]
+            order = rec["phaseOrder"]
+            assert order.index("window.build") < order.index("window.seal")
+            assert (
+                order.index("window.seal") < order.index("window.collect")
+            )
+            assert len(rec["threads"]) >= 2
+        assert recorder.traced_blocks(spans) == [1, 2, 3, 4, 5]
+
+    def test_occupancy_agrees_with_gauge(self, traced_replay):
+        """Acceptance gate: occupancy recomputed FROM SPANS lands
+        within 0.05 of the live pipeline_occupancy gauge."""
+        stats, spans = traced_replay
+        assert abs(
+            recorder.occupancy(spans) - stats.pipeline_occupancy
+        ) < 0.05
+
+    def test_phase_percentiles(self, traced_replay):
+        _, spans = traced_replay
+        pct = recorder.phase_percentiles(spans)
+        for phase in recorder.REQUIRED_PHASES:
+            assert pct[phase]["count"] > 0
+            assert (
+                pct[phase]["p50_s"]
+                <= pct[phase]["p90_s"]
+                <= pct[phase]["p99_s"]
+            )
+
+
+# ----------------------------------------------------------- export
+
+
+class TestExport:
+    def test_chrome_trace_json_valid(self, traced_replay, tmp_path):
+        _, spans = traced_replay
+        path = tmp_path / "trace.json"
+        export.dump_chrome_trace(str(path), spans)
+        doc = json.loads(path.read_text())
+        events = doc["traceEvents"]
+        assert events and doc["displayTimeUnit"] == "ms"
+        assert all(e["ph"] in ("M", "X", "i", "s", "f") for e in events)
+        # every complete event carries microsecond ts + dur
+        xs = [e for e in events if e["ph"] == "X"]
+        assert xs and all(e["dur"] >= 0 and "ts" in e for e in xs)
+        # cross-thread handoffs emit PAIRED flow events on distinct tids
+        starts = {e["id"]: e for e in events if e["ph"] == "s"}
+        finishes = [e for e in events if e["ph"] == "f"]
+        assert finishes and starts
+        for f in finishes:
+            s = starts[f["id"]]
+            assert s["tid"] != f["tid"]
+
+    def test_snapshot_rpc_payload(self, traced_replay):
+        """The khipu_traces RPC body while the ring still holds the
+        replay's spans (module fixture keeps the tracer enabled)."""
+        snap = export.snapshot()
+        assert snap["enabled"] and snap["dropped"] == 0
+        assert snap["blocks"] == [1, 2, 3, 4, 5]
+        assert set(recorder.REQUIRED_PHASES) <= set(
+            snap["phasePercentiles"]
+        )
+        assert 0.0 <= snap["occupancy"] <= 1.0
+        assert {"hits", "misses", "evictions"} <= set(
+            snap["compileCache"]
+        )
+        block = export.trace_block(2)
+        assert block["complete"]
+
+    def test_eth_service_exposes_trace_rpcs(self):
+        from khipu_tpu.jsonrpc.eth_service import EthService
+
+        for name in ("khipu_traces", "khipu_trace_block",
+                     "khipu_dump_chrome_trace"):
+            assert callable(getattr(EthService, name))
+
+
+# ------------------------------------------------- fused compile cache
+
+
+class TestCompileCache:
+    def test_lru_eviction_bounded_and_logged(self):
+        from khipu_tpu.trie.fused import _build_fused, compile_cache
+
+        old_cap = compile_cache.stats()["capacity"]
+        compile_cache.clear()
+        recorder.compile_log.reset()
+        try:
+            compile_cache.set_capacity(2)
+            sigs = [((1, 16, 4),), ((1, 32, 4),), ((1, 48, 4),)]
+            for sig in sigs:
+                _build_fused(sig, 8, True, 0)
+            st = compile_cache.stats()
+            assert st["size"] == 2 and st["capacity"] == 2
+            log = recorder.compile_log.snapshot()
+            assert log["misses"] == 3
+            assert log["evictions"] == 1  # oldest signature evicted
+            # the evicted signature misses again; the resident ones hit
+            _build_fused(sigs[0], 8, True, 0)
+            _build_fused(sigs[2], 8, True, 0)
+            log = recorder.compile_log.snapshot()
+            assert log["misses"] == 4 and log["hits"] == 1
+            kinds = [e["kind"] for e in log["events"]]
+            assert kinds.count("evict") == log["evictions"]
+        finally:
+            compile_cache.set_capacity(old_cap)
+            compile_cache.clear()
+            recorder.compile_log.reset()
+
+    def test_set_capacity_evicts_down(self):
+        from khipu_tpu.trie.fused import _build_fused, compile_cache
+
+        old_cap = compile_cache.stats()["capacity"]
+        compile_cache.clear()
+        recorder.compile_log.reset()
+        try:
+            compile_cache.set_capacity(8)
+            for n in (16, 32, 48, 64):
+                _build_fused(((1, n, 4),), 8, True, 0)
+            assert compile_cache.stats()["size"] == 4
+            compile_cache.set_capacity(1)
+            assert compile_cache.stats()["size"] == 1
+            assert recorder.compile_log.snapshot()["evictions"] == 3
+        finally:
+            compile_cache.set_capacity(old_cap)
+            compile_cache.clear()
+            recorder.compile_log.reset()
+
+
+# ------------------------------------------------- bench.py --trace
+
+
+class TestBenchTrace:
+    def test_traced_bench_breakdown_matches_wall(self):
+        """Satellite gate: the --trace per-phase breakdown (driver
+        phases tile the driver's wall clock) sums to within 10% of the
+        replay's measured wall time on the tiny fixture chain. Host
+        hasher (device_commit=False) keeps this out of 'slow'."""
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        from bench import run_traced_replay
+
+        stats, report = run_traced_replay(
+            n_blocks=6, txs_per_block=4, window=2, pipeline_depth=2,
+            device_commit=False,
+        )
+        assert not tracer.enabled  # helper restores the default
+        assert stats.blocks == 6
+        assert report["wall_s"] > 0
+        assert (
+            abs(report["driver_total_s"] - report["wall_s"])
+            <= 0.10 * report["wall_s"]
+        )
+        for phase in recorder.REQUIRED_PHASES:
+            assert phase in report["phase_seconds"], report["phase_seconds"]
+        assert report["dropped"] == 0
+        assert abs(
+            report["occupancy_spans"] - report["occupancy_gauge"]
+        ) < 0.05
