@@ -784,7 +784,66 @@ def bench_replay_traced(chrome_out=None):
     )
 
 
+def bench_replay_chaos(seed=0, n_blocks=32, txs_per_block=50, window=4,
+                       pipeline_depth=4):
+    """``bench.py --chaos=<seed>``: the deep-pipeline headline config
+    under a STANDARD deterministic fault mix (slow store reads, slow
+    persists, occasional fused-dispatch failures falling back to the
+    host hasher), reported next to a clean run of the same shape — the
+    robustness overhead in one line. Same seed, same fault sequence
+    (chaos/plan.py determinism contract)."""
+    from khipu_tpu.chaos import FaultPlan, FaultRule, active, fault_log
+
+    clean = _bench_replay_stats(
+        n_blocks, txs_per_block, parallel=True, window=window,
+        pipeline_depth=pipeline_depth,
+    )
+    fault_log.reset()
+    plan = FaultPlan(seed=seed, rules=[
+        # slow disk: 1-in-1000 node/kv reads stall 0.5ms
+        FaultRule("storage.kv.get", "latency", prob=0.001,
+                  latency_s=0.0005),
+        FaultRule("storage.node.get", "latency", prob=0.001,
+                  latency_s=0.0005),
+        # slow persist phase: a quarter of windows pay +2ms
+        FaultRule("collector.persist", "latency", prob=0.25,
+                  latency_s=0.002),
+        # flaky device: 5% of fused dispatches fail -> host fallback
+        FaultRule("fused.dispatch", "raise", prob=0.05),
+    ])
+    with active(plan):
+        stats = _bench_replay_stats(
+            n_blocks, txs_per_block, parallel=True, window=window,
+            pipeline_depth=pipeline_depth,
+        )
+    snap = fault_log.snapshot()
+    emit(
+        "replay_chaos_blocks_per_sec",
+        round(stats.blocks_per_s, 2),
+        "blocks/s",
+        clean_blocks_per_s=round(clean.blocks_per_s, 2),
+        degradation_pct=round(
+            100 * (1 - stats.blocks_per_s / clean.blocks_per_s)
+            if clean.blocks_per_s else 0, 1
+        ),
+        seed=seed,
+        faults_fired=snap["fired"],
+        faults_by_kind=snap["byKind"],
+        window=window,
+        pipeline_depth=pipeline_depth,
+        n_blocks=n_blocks,
+        txs_per_block=txs_per_block,
+        note="standard fault mix: latent reads + slow persists + "
+             "flaky fused dispatch (docs/recovery.md)",
+    )
+
+
 def main() -> None:
+    for arg in sys.argv[1:]:
+        if arg.startswith("--chaos"):
+            seed = int(arg.split("=", 1)[1]) if "=" in arg else 0
+            bench_replay_chaos(seed)
+            return
     if "--trace" in sys.argv:
         chrome_out = None
         for arg in sys.argv[1:]:

@@ -33,6 +33,7 @@ from typing import List, Optional
 import grpc
 
 from khipu_tpu.base.rlp import rlp_decode, rlp_encode
+from khipu_tpu.chaos import fault_point, fault_value
 from khipu_tpu.config import KhipuConfig
 from khipu_tpu.domain.block import Block
 from khipu_tpu.domain.blockchain import Blockchain
@@ -144,25 +145,29 @@ class BridgeServer:
     # ------------------------------------------------------------- server
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        def _guarded(name, fn):
+            # chaos seam per served method: a `latency` rule here models
+            # a slow shard (what the client's rpc_deadline exists for),
+            # a `raise` rule a shard-side failure
+            def handler(request, context):
+                fault_point(f"bridge.serve.{name}")
+                return fn(request, context)
+
+            return grpc.unary_unary_rpc_method_handler(
+                handler, _identity, _identity
+            )
+
         handlers = {
-            "ExecuteBlocks": grpc.unary_unary_rpc_method_handler(
-                self._execute_blocks, _identity, _identity
+            "ExecuteBlocks": _guarded(
+                "ExecuteBlocks", self._execute_blocks
             ),
-            "BestBlock": grpc.unary_unary_rpc_method_handler(
-                self._best_block, _identity, _identity
+            "BestBlock": _guarded("BestBlock", self._best_block),
+            "GetStateRoot": _guarded(
+                "GetStateRoot", self._get_state_root
             ),
-            "GetStateRoot": grpc.unary_unary_rpc_method_handler(
-                self._get_state_root, _identity, _identity
-            ),
-            "GetNodeData": grpc.unary_unary_rpc_method_handler(
-                self._get_node_data, _identity, _identity
-            ),
-            "PutNodeData": grpc.unary_unary_rpc_method_handler(
-                self._put_node_data, _identity, _identity
-            ),
-            "Ping": grpc.unary_unary_rpc_method_handler(
-                self._ping, _identity, _identity
-            ),
+            "GetNodeData": _guarded("GetNodeData", self._get_node_data),
+            "PutNodeData": _guarded("PutNodeData", self._put_node_data),
+            "Ping": _guarded("Ping", self._ping),
         }
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=self.max_workers)
@@ -183,16 +188,22 @@ class BridgeServer:
 class BridgeClient:
     """The JVM-side caller's shape, for tests and local tooling."""
 
-    def __init__(self, target: str):
+    def __init__(self, target: str, deadline: Optional[float] = None):
+        # ``deadline``: per-RPC gRPC deadline in seconds
+        # (ClusterConfig.rpc_deadline) — a hung shard surfaces as
+        # DEADLINE_EXCEEDED into the caller's retry/breaker machinery
+        # instead of blocking a reader forever. None = no deadline.
         self.channel = grpc.insecure_channel(target)
+        self.deadline = deadline
 
     def _call(self, method: str, payload: bytes) -> bytes:
+        fault_point(f"bridge.call.{method}")
         fn = self.channel.unary_unary(
             f"/{SERVICE}/{method}",
             request_serializer=_identity,
             response_deserializer=_identity,
         )
-        return fn(payload)
+        return fn(payload, timeout=self.deadline)
 
     def execute_blocks(self, blocks: List[Block]):
         payload = rlp_encode(
@@ -222,7 +233,12 @@ class BridgeClient:
         for start in range(0, len(hashes), 384):
             chunk = hashes[start : start + 384]
             out = rlp_decode(self._call("GetNodeData", rlp_encode(chunk)))
-            result.update(h_v for h_v in zip(chunk, out) if h_v[1])
+            # data seam: a `corrupt` rule bit-flips a fetched node —
+            # the caller's content-address check MUST reject it
+            result.update(
+                (h, fault_value("bridge.node.value", v))
+                for h, v in zip(chunk, out) if v
+            )
         return result
 
     def put_node_data(self, nodes) -> int:

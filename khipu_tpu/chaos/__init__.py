@@ -1,0 +1,31 @@
+"""Deterministic fault-injection harness (docs/recovery.md)."""
+
+from khipu_tpu.chaos.plan import (
+    FaultLog,
+    FaultPlan,
+    FaultRule,
+    InjectedDeath,
+    InjectedFault,
+    active,
+    apply_config,
+    fault_log,
+    fault_point,
+    fault_value,
+    install,
+    uninstall,
+)
+
+__all__ = [
+    "FaultLog",
+    "FaultPlan",
+    "FaultRule",
+    "InjectedDeath",
+    "InjectedFault",
+    "active",
+    "apply_config",
+    "fault_log",
+    "fault_point",
+    "fault_value",
+    "install",
+    "uninstall",
+]

@@ -1,0 +1,295 @@
+"""Deterministic, seeded fault injection behind zero-cost seams.
+
+The retry/breaker machinery (cluster/client.py), the deep pipeline's
+failure semantics (sync/replay.py) and the content-address admission
+checks (bridge.py, cluster fetch) had only ever been exercised by
+happy-path unit tests. This module provokes the failure modes ON
+PURPOSE: hot paths call ``fault_point("site")`` / ``fault_value("site",
+v)`` seams which, with no plan installed, cost one module attribute
+load and one ``is None`` branch (the ``_NULL_SPAN`` cost model from
+observability/trace.py — behavior is bit-exact identical to an
+uninstrumented build). With a ``FaultPlan`` installed, rules matched
+against the site fire deterministically: every random draw comes from
+a per-(rule, site) RNG derived from ``(seed, rule index, site)`` and
+is consumed in per-site hit order, so the same seed over the same
+workload fires the same faults at the same hits, run after run.
+
+Fault taxonomy (docs/recovery.md):
+
+* ``raise``   — raise ``InjectedFault`` (an ``Exception``): transport
+  errors, store failures. Exercises retries, breakers, failover and
+  the pipeline's abort path.
+* ``latency`` — sleep ``latency_s``: slow shards, slow disks.
+  Exercises deadlines and backpressure.
+* ``corrupt`` — flip ONE bit of the value passing through a
+  ``fault_value`` seam: wire/disk corruption. Content-address
+  verification MUST catch every one — a silent acceptance is a bug.
+* ``die``     — raise ``InjectedDeath`` (a ``BaseException``, so
+  ordinary ``except Exception`` recovery cannot swallow it): simulated
+  process death mid-job. The window collector treats it as a SIGKILL —
+  the thread stops silently, leaving partial state for recovery.
+
+Every fired fault is recorded in the plan's ``fired`` log, the module
+``fault_log`` ring (surfaced by khipu_metrics) and, when the tracer is
+enabled, as a ``chaos.fault`` event in the PR-3 flight recorder.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from khipu_tpu.observability.trace import event as _trace_event
+
+__all__ = [
+    "InjectedFault",
+    "InjectedDeath",
+    "FaultRule",
+    "FaultPlan",
+    "FaultLog",
+    "fault_log",
+    "fault_point",
+    "fault_value",
+    "install",
+    "uninstall",
+    "active",
+    "apply_config",
+]
+
+KINDS = ("raise", "latency", "corrupt", "die")
+
+
+class InjectedFault(Exception):
+    """A deliberate failure from a ``raise`` rule. An ordinary
+    Exception: retry/breaker/failover paths handle it like any
+    transport or store error."""
+
+
+class InjectedDeath(BaseException):
+    """Simulated process death from a ``die`` rule. Deliberately NOT an
+    Exception so generic recovery cannot catch it — the component that
+    models the death (the collector thread) handles it explicitly; for
+    everything else it propagates like a kill signal."""
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    """One injection rule. ``site`` matches a seam name exactly, or as
+    a prefix when it ends with ``*`` (``"cluster.call:*"``). The rule
+    arms after ``after`` hits of the site, fires with probability
+    ``prob`` per hit, and at most ``times`` times total (None =
+    unlimited)."""
+
+    site: str
+    kind: str  # raise | latency | corrupt | die
+    prob: float = 1.0
+    after: int = 0
+    times: Optional[int] = None
+    latency_s: float = 0.01
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+    def matches(self, site: str) -> bool:
+        if self.site.endswith("*"):
+            return site.startswith(self.site[:-1])
+        return site == self.site
+
+
+class FaultLog:
+    """Bounded ring + counters of fired faults (the CompileEventLog
+    shape from observability/recorder.py), surfaced by khipu_metrics
+    whether or not the tracer ring is enabled."""
+
+    def __init__(self, capacity: int = 4096):
+        from collections import deque
+
+        self._ring = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.counts: Dict[str, int] = {k: 0 for k in KINDS}
+        self.by_site: Dict[str, int] = {}
+
+    def record(self, site: str, kind: str, hit: int, rule_index: int):
+        with self._lock:
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+            self.by_site[site] = self.by_site.get(site, 0) + 1
+            self._ring.append(
+                {"site": site, "kind": kind, "hit": hit,
+                 "rule": rule_index}
+            )
+        _trace_event("chaos.fault", site=site, kind=kind, hit=hit)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "fired": sum(self.counts.values()),
+                "byKind": dict(self.counts),
+                "bySite": dict(self.by_site),
+            }
+
+    def recent(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.counts = {k: 0 for k in KINDS}
+            self.by_site = {}
+
+
+fault_log = FaultLog()
+
+
+class FaultPlan:
+    """A seeded set of rules evaluated at every seam hit.
+
+    Determinism contract: per-site hit counters advance on every hit;
+    each (rule, site) pair draws from its OWN ``random.Random`` seeded
+    from ``keccak256(f"{seed}:{rule_index}:{site}")`` — independent of
+    dict order, thread interleaving across DIFFERENT sites, and of any
+    other rule. Replaying the same workload with the same seed fires
+    the same (site, hit, kind) sequence.
+    """
+
+    def __init__(self, seed: int = 0, rules: Optional[List[FaultRule]] = None,
+                 sleep=time.sleep):
+        self.seed = int(seed)
+        self.rules: Tuple[FaultRule, ...] = tuple(rules or ())
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        self._fire_counts: Dict[int, int] = {}
+        self._rngs: Dict[Tuple[int, str], object] = {}
+        # every fired fault, in fire order: (site, hit, kind, rule idx)
+        self.fired: List[Tuple[str, int, str, int]] = []
+
+    # ----------------------------------------------------------- plumbing
+
+    def _rng(self, rule_index: int, site: str):
+        import random
+
+        from khipu_tpu.base.crypto.keccak import keccak256
+
+        key = (rule_index, site)
+        rng = self._rngs.get(key)
+        if rng is None:
+            digest = keccak256(
+                f"{self.seed}:{rule_index}:{site}".encode()
+            )
+            rng = self._rngs[key] = random.Random(
+                int.from_bytes(digest[:8], "big")
+            )
+        return rng
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    # --------------------------------------------------------------- fire
+
+    def fire(self, site: str, value: Optional[bytes] = None):
+        """Evaluate every rule against one seam hit; returns ``value``
+        (possibly corrupted). Raising kinds raise after the fire is
+        logged, so the record survives the exception."""
+        actions = []
+        with self._lock:
+            hit = self._hits[site] = self._hits.get(site, 0) + 1
+            for i, rule in enumerate(self.rules):
+                if not rule.matches(site):
+                    continue
+                if hit <= rule.after:
+                    continue
+                if (rule.times is not None
+                        and self._fire_counts.get(i, 0) >= rule.times):
+                    continue
+                if rule.prob < 1.0:
+                    # draw consumed in per-site hit order — the
+                    # determinism invariant
+                    if self._rng(i, site).random() >= rule.prob:
+                        continue
+                self._fire_counts[i] = self._fire_counts.get(i, 0) + 1
+                self.fired.append((site, hit, rule.kind, i))
+                actions.append((i, rule, hit))
+        for i, rule, hit in actions:
+            fault_log.record(site, rule.kind, hit, i)
+            if rule.kind == "latency":
+                self._sleep(rule.latency_s)
+            elif rule.kind == "corrupt":
+                if isinstance(value, (bytes, bytearray)) and len(value):
+                    rng = self._rng(i, site)
+                    flipped = bytearray(value)
+                    pos = rng.randrange(len(flipped))
+                    flipped[pos] ^= 1 << rng.randrange(8)
+                    value = bytes(flipped)
+            elif rule.kind == "raise":
+                raise InjectedFault(
+                    f"injected fault at {site} (hit {hit}, rule {i})"
+                )
+            else:  # die
+                raise InjectedDeath(
+                    f"injected death at {site} (hit {hit}, rule {i})"
+                )
+        return value
+
+
+# THE installed plan. ``None`` (the default) keeps both seams below at
+# one attribute load + branch — the zero-cost-disabled contract.
+_PLAN: Optional[FaultPlan] = None
+
+
+def fault_point(site: str) -> None:
+    """Control seam: may raise, sleep, or do nothing."""
+    plan = _PLAN
+    if plan is not None:
+        plan.fire(site)
+
+
+def fault_value(site: str, value):
+    """Data seam: the value flows THROUGH the harness, which may
+    corrupt it (or raise/sleep). Identity when no plan is installed."""
+    plan = _PLAN
+    if plan is None:
+        return value
+    return plan.fire(site, value)
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _PLAN
+    _PLAN = plan
+    return plan
+
+
+def uninstall() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+@contextmanager
+def active(plan: FaultPlan):
+    """``with active(FaultPlan(seed=7, rules=[...])): ...`` — install
+    for the block, always uninstall after (test hygiene)."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        uninstall()
+
+
+def apply_config(cfg) -> None:
+    """Wire a config.FaultConfig. Idempotent; a disabled config never
+    stomps a plan a test installed explicitly (the apply_config
+    convention from observability/trace.py)."""
+    if cfg is None or not getattr(cfg, "enabled", False):
+        return
+    if _PLAN is not None:
+        return
+    rules = [
+        r if isinstance(r, FaultRule) else FaultRule(*r)
+        for r in cfg.rules
+    ]
+    install(FaultPlan(seed=cfg.seed, rules=rules))

@@ -25,6 +25,7 @@ import time
 from typing import Callable, Dict, List, Mapping, Optional, Sequence
 
 from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.chaos import fault_point, fault_value
 from khipu_tpu.cluster.ring import HashRing
 from khipu_tpu.observability.trace import span
 
@@ -102,6 +103,7 @@ class ShardMetrics:
         self.failures = 0  # RPC errors (timeouts, resets, refusals)
         self.failovers = 0  # key-groups handed to the next replica
         self.replicated = 0  # keys write-replicated to this shard
+        self.backfilled = 0  # keys re-replicated at re-join (anti-entropy)
         self.latency_ns = 0  # total RPC wall time
 
     def snapshot(self, breaker: CircuitBreaker, alive: bool) -> dict:
@@ -115,6 +117,7 @@ class ShardMetrics:
             "failures": self.failures,
             "failovers": self.failovers,
             "replicated": self.replicated,
+            "backfilled": self.backfilled,
             "latencySeconds": round(self.latency_ns / 1e9, 6),
             "hitRate": round(
                 self.served / max(1, self.served + self.missing), 4
@@ -144,10 +147,17 @@ class ShardedNodeClient:
         channel_factory: Optional[Callable[[str], object]] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        rpc_deadline: Optional[float] = None,
+        missed_cap: int = 100_000,
     ):
         if not endpoints:
             raise ValueError("cluster needs at least one endpoint")
         self.ring = HashRing(endpoints, replication, vnodes)
+        # the CONFIGURED membership, never shrunk by health verdicts —
+        # what a dead endpoint OWNS while it is out of the live ring
+        # (the anti-entropy backfill's source of truth)
+        self._full_ring = HashRing(endpoints, replication, vnodes)
+        self.rpc_deadline = rpc_deadline
         self.local_get = local_get
         self.max_retries = max_retries
         self.backoff_base = backoff_base
@@ -167,14 +177,23 @@ class ShardedNodeClient:
         self.local_fallbacks = 0  # keys served by the local store
         self.unreachable = 0  # keys no copy could serve
         self._health = None  # attached by HealthMonitor
+        # keys owed to an endpoint that could not take its replica
+        # (dead at placement time, or the batch RPC failed) — drained
+        # by ``backfill`` when the endpoint re-joins. Bounded: beyond
+        # ``missed_cap`` total keys new debts are dropped and counted
+        # (the endpoint then needs an offline re-sync, not a backfill)
+        self.missed_cap = missed_cap
+        self.missed_dropped = 0
+        self._missed: Dict[str, Dict[bytes, None]] = {}
+        self._missed_total = 0
+        self._missed_lock = threading.Lock()
 
     # -------------------------------------------------------- transport
 
-    @staticmethod
-    def _grpc_factory(endpoint: str):
+    def _grpc_factory(self, endpoint: str):
         from khipu_tpu.bridge import BridgeClient
 
-        return BridgeClient(endpoint)
+        return BridgeClient(endpoint, deadline=self.rpc_deadline)
 
     def _channel(self, endpoint: str):
         with self._channel_lock:
@@ -207,6 +226,10 @@ class ShardedNodeClient:
             m.requests += 1
             t0 = self._clock()
             try:
+                # chaos seam: a `raise` rule (site "cluster.call:*" or
+                # per-endpoint) is indistinguishable from an RPC error —
+                # it feeds the same retry/backoff/breaker machinery
+                fault_point(f"cluster.call:{endpoint}")
                 with span(
                     "cluster.call", endpoint=endpoint, attempt=attempt
                 ):
@@ -270,6 +293,11 @@ class ShardedNodeClient:
                     still: List[bytes] = []
                     for h in want:
                         v = got.get(h)
+                        if v is not None:
+                            # data seam: `corrupt` rules bit-flip the
+                            # fetched bytes — the admission check below
+                            # MUST catch every one
+                            v = fault_value("cluster.fetch.value", v)
                         if v is None:
                             m.missing += 1
                             still.append(h)
@@ -296,11 +324,21 @@ class ShardedNodeClient:
         """Write-replicate nodes to every replica of each key; returns
         the number of (key, endpoint) placements that succeeded. A dead
         replica is skipped (its breaker records the failure) — the
-        read path's failover covers the gap until it heals."""
+        read path's failover covers the gap until it heals, and the
+        keys the skip left un-placed are remembered per FULL-ring owner
+        so ``backfill`` squares the debt at re-join (anti-entropy)."""
+        fault_point("cluster.replicate")
+        alive = set(self.ring.members)
         per_endpoint: Dict[str, Dict[bytes, bytes]] = {}
         for h, v in nodes.items():
-            for endpoint in self.ring.replicas_for(bytes(h)):
-                per_endpoint.setdefault(endpoint, {})[bytes(h)] = bytes(v)
+            hb = bytes(h)
+            for endpoint in self.ring.replicas_for(hb):
+                per_endpoint.setdefault(endpoint, {})[hb] = bytes(v)
+            # an out-of-ring CONFIGURED owner missed this write — it
+            # comes back with a stale cache unless backfilled
+            for endpoint in self._full_ring.replicas_for(hb):
+                if endpoint not in alive:
+                    self._record_missed(endpoint, (hb,))
         placed = 0
         for endpoint, batch in per_endpoint.items():
             try:
@@ -309,8 +347,64 @@ class ShardedNodeClient:
                     lambda ch, b=batch: ch.put_node_data(b),
                 )
             except Exception:
+                # the batch never landed: same debt as a dead owner
+                self._record_missed(endpoint, batch)
                 continue
             self.metrics[endpoint].replicated += len(batch)
+            placed += len(batch)
+        return placed
+
+    # ---------------------------------------------------- anti-entropy
+
+    def _record_missed(self, endpoint: str, keys) -> None:
+        with self._missed_lock:
+            bucket = self._missed.setdefault(endpoint, {})
+            for h in keys:
+                if h in bucket:
+                    continue
+                if self._missed_total >= self.missed_cap:
+                    self.missed_dropped += 1
+                    continue
+                bucket[h] = None
+                self._missed_total += 1
+
+    def backfill(self, endpoint: str) -> int:
+        """Anti-entropy at re-join (HealthMonitor dead->alive): push
+        every key the endpoint missed while out of the ring. Values
+        come from the local store first, then a cluster fetch; keys no
+        copy can produce are dropped (nothing left to replicate).
+        Returns keys re-replicated. Failed pushes re-enter the debt."""
+        with self._missed_lock:
+            bucket = self._missed.pop(endpoint, None)
+            if bucket:
+                self._missed_total -= len(bucket)
+        if not bucket:
+            return 0
+        keys = list(bucket)
+        placed = 0
+        for start in range(0, len(keys), 384):
+            chunk = keys[start : start + 384]
+            batch: Dict[bytes, bytes] = {}
+            missing: List[bytes] = []
+            for h in chunk:
+                v = self.local_get(h) if self.local_get else None
+                if v is not None and keccak256(v) == h:
+                    batch[h] = v
+                else:
+                    missing.append(h)
+            if missing:
+                batch.update(self.fetch(missing))
+            if not batch:
+                continue
+            try:
+                self._call(
+                    endpoint,
+                    lambda ch, b=batch: ch.put_node_data(b),
+                )
+            except Exception:
+                self._record_missed(endpoint, batch)
+                continue
+            self.metrics[endpoint].backfilled += len(batch)
             placed += len(batch)
         return placed
 
@@ -347,6 +441,8 @@ class ShardedNodeClient:
             "members": list(self.ring.members),
             "localFallbacks": self.local_fallbacks,
             "unreachable": self.unreachable,
+            "missedKeys": self._missed_total,
+            "missedDropped": self.missed_dropped,
             "shards": {
                 ep: m.snapshot(self.breakers[ep], ep in alive)
                 for ep, m in self.metrics.items()

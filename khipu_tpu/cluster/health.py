@@ -67,6 +67,19 @@ class HealthMonitor:
                     self.transitions += 1
                     self.client.mark_alive(ep)
                     self.log(f"cluster: {ep} re-joined the ring")
+                    # anti-entropy: push the writes the endpoint missed
+                    # while it was out of the ring (client.backfill)
+                    backfill = getattr(self.client, "backfill", None)
+                    if backfill is not None:
+                        try:
+                            pushed = backfill(ep)
+                        except Exception:
+                            pushed = -1  # debt re-recorded by backfill
+                        if pushed:
+                            self.log(
+                                f"cluster: backfilled {pushed} missed "
+                                f"keys onto {ep}"
+                            )
             else:
                 self._hits[ep] = 0
                 self._misses[ep] = self._misses.get(ep, 0) + 1

@@ -117,6 +117,18 @@ class SyncConfig:
     # and persists window N (docs/window_pipeline.md). 1 = the old
     # seal/collect lockstep, still off the driver thread
     pipeline_depth: int = 2
+    # write-ahead window-commit journal (sync/journal.py —
+    # docs/recovery.md): an intent record lands before the background
+    # collector's first mutation, a commit mark after best advances;
+    # recover() repairs or rolls back anything in between after a crash
+    commit_journal: bool = True
+    # a dead collector thread (detected by liveness checks in
+    # submit/drain) degrades the driver to synchronous commits instead
+    # of aborting the replay; False = abort with CollectorDied (what a
+    # real process death looks like to the driver)
+    degrade_on_collector_death: bool = True
+    # close()/kill() raise/warn when the worker outlives this join
+    collector_join_timeout: float = 60.0
     # opcode-level trace for ONE block number (debug-trace-at;
     # VM.scala:40-57) — that block runs sequentially with a per-op line
     debug_trace_at: Optional[int] = None
@@ -159,6 +171,25 @@ class ClusterConfig:
     probe_interval: float = 5.0  # health probe period (s)
     down_after: int = 2  # missed probes to leave the ring
     up_after: int = 1  # good probes to re-join
+    # per-RPC gRPC deadline (s) on bridge client calls — a hung shard
+    # surfaces as DEADLINE_EXCEEDED into the retry/breaker machinery
+    # instead of blocking a reader forever. None = no deadline
+    rpc_deadline: Optional[float] = 10.0
+
+
+@dataclass(frozen=True)
+class FaultConfig:
+    """Deterministic fault injection (chaos/ package — docs/recovery.md).
+
+    Disabled (the default) keeps every ``fault_point``/``fault_value``
+    seam one module attribute load + ``is None`` branch — bit-exact
+    identical replay behavior, the _NULL_SPAN cost model. ``rules``
+    entries are ``chaos.FaultRule`` instances or their positional
+    tuples ``(site, kind, prob, after, times, latency_s)``."""
+
+    enabled: bool = False
+    seed: int = 0
+    rules: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -170,6 +201,7 @@ class KhipuConfig:
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig
     )
+    faults: FaultConfig = field(default_factory=FaultConfig)
 
 
 def fixture_config(

@@ -499,6 +499,23 @@ class EthService:
             "occupancy": PIPELINE_GAUGES["occupancy"],
             "driverStallSeconds": PIPELINE_GAUGES["driver_stall_s"],
             "collectorBusySeconds": PIPELINE_GAUGES["collector_busy_s"],
+            "collectorDeaths": PIPELINE_GAUGES["collector_deaths"],
+            "syncFallbackWindows": PIPELINE_GAUGES[
+                "sync_fallback_windows"
+            ],
+        }
+        # graceful-degradation + robustness gauges (docs/recovery.md):
+        # fused->host fallbacks, WAL depth, fired chaos faults
+        from khipu_tpu.chaos import fault_log
+        from khipu_tpu.ledger.window import WINDOW_GAUGES
+
+        out["robustness"] = {
+            "fusedFallbacks": WINDOW_GAUGES["fused_fallbacks"],
+            "journalDepth": (
+                s.window_journal.depth
+                if self.config.sync.commit_journal else 0
+            ),
+            "faults": fault_log.snapshot(),
         }
         return out
 

@@ -26,7 +26,7 @@ from khipu_tpu.base.crypto.keccak import keccak256
 from khipu_tpu.domain.account import address_key
 from khipu_tpu.domain.block_header import BlockHeader
 from khipu_tpu.ledger.world import BlockWorldState
-from khipu_tpu.observability.trace import span
+from khipu_tpu.observability.trace import event, span
 from khipu_tpu.trie.bulk import Hasher, host_hasher
 from khipu_tpu.trie.deferred import (
     DeferredMPT,
@@ -37,6 +37,16 @@ from khipu_tpu.trie.deferred import (
     _PLACEHOLDER_PREFIX,
 )
 from khipu_tpu.trie.mpt import EMPTY_TRIE_HASH
+
+
+# graceful-degradation gauges served by the khipu_metrics RPC
+# (jsonrpc/eth_service.py). Module-level dict (not on the committer):
+# committers are rebuilt every epoch and the metric must survive them.
+WINDOW_GAUGES = {
+    # windows whose fused device dispatch failed at runtime and fell
+    # back to the host hasher (docs/recovery.md graceful degradation)
+    "fused_fallbacks": 0,
+}
 
 
 class _StagedReadThrough:
@@ -341,6 +351,27 @@ class WindowCommitter:
                 return job
             except FusedUnsupported:
                 pass
+            except Exception as e:
+                # a RUNTIME device failure (driver error, OOM, a chaos
+                # `raise` at the fused.dispatch seam) — degrade this
+                # window to the host hasher instead of killing the
+                # replay; the root checks at collect still gate
+                # persistence, so correctness is unaffected.
+                # InjectedDeath is a BaseException and propagates.
+                import sys
+
+                WINDOW_GAUGES["fused_fallbacks"] += 1
+                event(
+                    "window.degrade",
+                    error=type(e).__name__,
+                    nodes=len(to_resolve),
+                )
+                print(
+                    "WARNING: fused window dispatch failed "
+                    f"({type(e).__name__}: {e}); hashing this window "
+                    "on the host",
+                    file=sys.stderr,
+                )
         # host path: level-synchronous hasher loop, resolved eagerly.
         # Cross-window refs seed the mapping from the source job's
         # digests (a blocking collect of the device output — rare: only

@@ -32,6 +32,18 @@ class ServiceBoard:
         self.blockchain = Blockchain(self.storages, config)
         if self.blockchain.get_header_by_number(0) is None:
             self.blockchain.load_genesis(genesis or GenesisSpec())
+        # crash-recovery startup pass (sync/journal.py): settle any
+        # window-commit intents a previous process death left pending —
+        # repair complete windows, roll partial ones back. None when
+        # the journal is clean (the overwhelmingly common boot).
+        self.recovery_report = None
+        if config.sync.commit_journal:
+            if self.storages.window_journal.pending():
+                from khipu_tpu.sync.journal import recover
+
+                self.recovery_report = recover(
+                    self.blockchain, log=print
+                )
         self.tx_pool = PendingTransactionsPool()
         self.ommers_pool = OmmersPool()
         self.node_key = self._load_or_create_node_key()
@@ -175,6 +187,7 @@ class ServiceBoard:
             breaker_failures=cc.breaker_failures,
             breaker_reset=cc.breaker_reset,
             local_get=local_only,
+            rpc_deadline=cc.rpc_deadline,
         )
         self.storages.account_node_storage = (
             RemoteReadThroughNodeStorage.from_cluster(

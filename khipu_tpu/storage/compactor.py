@@ -27,6 +27,7 @@ class CompactionReport:
     storage_nodes: int = 0
     code_blobs: int = 0
     missing: int = 0
+    corrupt: int = 0  # stored bytes whose keccak != key (verify_hashes)
 
     @property
     def total(self) -> int:
@@ -42,10 +43,19 @@ def compact(
     storage_dst,
     evmcode_dst,
     batch: int = 1000,
+    verify_hashes: bool = False,
 ) -> CompactionReport:
     """Walk the trie at ``state_root``; copy every reachable node/blob
     from the src stores into the dst stores. Returns counts
-    (KesqueCompactor's NodeReader/NodeWriter roles)."""
+    (KesqueCompactor's NodeReader/NodeWriter roles).
+
+    ``verify_hashes`` re-checks every value against its content address
+    (all three stores are content-addressed) — the crash-recovery walk
+    (sync/journal.py) uses it so a torn or bit-flipped record counts as
+    ``corrupt`` instead of silently propagating."""
+    if verify_hashes:
+        from khipu_tpu.base.crypto.keccak import keccak256
+
     report = CompactionReport()
     pending: List[Tuple[int, bytes]] = [(STATE_NODE, state_root)]
     seen = {state_root}
@@ -66,6 +76,9 @@ def compact(
         if value is None:
             report.missing += 1
             continue
+        if verify_hashes and keccak256(value) != h:
+            report.corrupt += 1
+            continue  # children unreadable from corrupt bytes
         buffers[kind][h] = value
         if kind == STATE_NODE:
             report.state_nodes += 1
@@ -85,11 +98,13 @@ def compact(
 
 
 def verify_reachable(
-    account_src, storage_src, evmcode_src, state_root: bytes
+    account_src, storage_src, evmcode_src, state_root: bytes,
+    verify_hashes: bool = False,
 ) -> CompactionReport:
     """DataChecker role (tools/DataChecker.scala:122): walk the whole
     state trie at a block and assert every node is retrievable; the
-    report's ``missing`` count is the integrity verdict."""
+    report's ``missing`` (and, with ``verify_hashes``, ``corrupt``)
+    counts are the integrity verdict."""
 
     class _Null:
         def update(self, r, u):
@@ -97,5 +112,6 @@ def verify_reachable(
 
     null = _Null()
     return compact(
-        account_src, storage_src, evmcode_src, state_root, null, null, null
+        account_src, storage_src, evmcode_src, state_root,
+        null, null, null, verify_hashes=verify_hashes,
     )

@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 
+from khipu_tpu.chaos import fault_point
 from khipu_tpu.storage.cache import Clock
 
 
@@ -93,6 +94,7 @@ class MemoryKeyValueDataSource(KeyValueDataSource):
         self._lock = threading.Lock()
 
     def get(self, key: bytes) -> Optional[bytes]:
+        fault_point("storage.kv.get")
         t0 = self.clock.start()
         try:
             return self._map.get(bytes(key))
@@ -100,6 +102,7 @@ class MemoryKeyValueDataSource(KeyValueDataSource):
             self.clock.elapse(t0)
 
     def update(self, to_remove, to_upsert) -> None:
+        fault_point("storage.kv.put")
         with self._lock:
             for k in to_remove:
                 self._map.pop(bytes(k), None)
@@ -117,6 +120,22 @@ class MemoryKeyValueDataSource(KeyValueDataSource):
 class MemoryNodeDataSource(MemoryKeyValueDataSource, NodeDataSource):
     """In-memory content-addressed node store (EphemNodeDataSource)."""
 
+    def get(self, key: bytes) -> Optional[bytes]:
+        fault_point("storage.node.get")
+        t0 = self.clock.start()
+        try:
+            return self._map.get(bytes(key))
+        finally:
+            self.clock.elapse(t0)
+
+    def update(self, to_remove, to_upsert) -> None:
+        fault_point("storage.node.put")
+        with self._lock:
+            for k in to_remove:
+                self._map.pop(bytes(k), None)
+            for k, v in to_upsert.items():
+                self._map[bytes(k)] = bytes(v)
+
 
 class MemoryBlockDataSource(BlockDataSource):
     def __init__(self) -> None:
@@ -126,6 +145,7 @@ class MemoryBlockDataSource(BlockDataSource):
         self._lock = threading.Lock()
 
     def get(self, number: int) -> Optional[bytes]:
+        fault_point("storage.block.get")
         t0 = self.clock.start()
         try:
             return self._map.get(int(number))
@@ -133,6 +153,7 @@ class MemoryBlockDataSource(BlockDataSource):
             self.clock.elapse(t0)
 
     def update(self, to_remove, to_upsert) -> None:
+        fault_point("storage.block.put")
         with self._lock:
             for n in to_remove:
                 self._map.pop(int(n), None)

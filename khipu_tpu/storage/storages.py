@@ -82,12 +82,26 @@ class Storages:
             self.block_number_storage, self.block_header_storage)
         self.transaction_storage = TransactionStorage(kv_src("tx"))
         self.app_state = AppStateStorage(kv_src("appstate"))
+        # write-ahead window-commit journal records (sync/journal.py —
+        # docs/recovery.md); same engine/durability as the block stores
+        self.journal_source = kv_src("journal")
+        self._window_journal = None
 
         self._node_storages = (
             self.account_node_storage,
             self.storage_node_storage,
             self.evmcode_storage,
         )
+
+    @property
+    def window_journal(self):
+        """The crash-consistency WAL (lazy: sync/journal.py imports
+        stay out of the storage layer's import graph)."""
+        if self._window_journal is None:
+            from khipu_tpu.sync.journal import WindowJournal
+
+            self._window_journal = WindowJournal(self.journal_source)
+        return self._window_journal
 
     @property
     def best_block_number(self) -> int:
@@ -131,6 +145,7 @@ class Storages:
         yield self.block_number_storage.source
         yield self.transaction_storage.source
         yield self.app_state.source
+        yield self.journal_source
 
     def flush(self) -> None:
         for s in self._node_storages:

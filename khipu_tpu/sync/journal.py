@@ -1,0 +1,312 @@
+"""Write-ahead window-commit journal + crash recovery.
+
+The deep pipeline (sync/replay.py) moved root checks, node/code
+persistence and block saves onto a background collector thread. A
+process death mid-job leaves node storage, block storage and
+``AppStateStorage.best_block_number`` mutually inconsistent — and
+before this module nothing on startup detected or repaired that.
+
+Protocol (two records per window, over the ``journal`` KV topic):
+
+* INTENT — written and flushed BEFORE the background job's first
+  mutation (the driver writes it at submit, the job runs strictly
+  after): ``[b"I", seq, lo, hi, parent_root, [expected_root, ...]]``
+  under key ``b"J" + seq``. The expected roots are the header state
+  roots the collector will verify — recovery re-verifies against the
+  same values.
+* COMMIT — ``b"\\x01"`` under key ``b"C" + seq``, written after the
+  window's last ``save_block`` advanced ``best_block_number``.
+
+A crash between the two leaves a pending intent. ``recover()`` scans
+them in order and, per window, either REPAIRS (every block present
+with the expected root, td/body/receipts stored, and the state trie at
+the window's last root fully reachable with every node's bytes
+matching its content address — node puts are content-addressed and
+idempotent, so a partially re-persisted window that verifies is simply
+complete) or ROLLS BACK (removes the window's partial block records
+and resets ``best_block_number`` to the last fully-committed window;
+orphaned trie nodes are harmless — content-addressed, unreferenced,
+reclaimed by the compactor). Once one window rolls back every later
+pending window rolls back too: its parent chain is gone.
+
+Crash points and their outcomes are enumerated in docs/recovery.md;
+tests/test_chaos.py provokes them with the chaos harness.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from khipu_tpu.base.rlp import rlp_decode, rlp_encode
+
+_INTENT_PREFIX = b"J"
+_COMMIT_PREFIX = b"C"
+_HEAD_KEY = b"head"  # next seq to assign
+_TAIL_KEY = b"tail"  # lowest seq not yet pruned
+
+
+def _seq_key(prefix: bytes, seq: int) -> bytes:
+    return prefix + int(seq).to_bytes(8, "big")
+
+
+def _int_bytes(n: int) -> bytes:
+    return int(n).to_bytes(8, "big").lstrip(b"\x00") or b"\x00"
+
+
+@dataclass
+class IntentRecord:
+    seq: int
+    lo: int
+    hi: int
+    parent_root: bytes
+    roots: List[bytes]  # expected header state roots, lo..hi
+
+
+class WindowJournal:
+    """The WAL over one KeyValueDataSource (``Storages.journal_source``
+    — every engine gives it the same durability as the block stores;
+    ``flush`` after the intent is the fsync barrier where the engine
+    has one)."""
+
+    def __init__(self, source):
+        self.source = source
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- pointers
+
+    def _get_int(self, key: bytes, default: int = 0) -> int:
+        v = self.source.get(key)
+        return int.from_bytes(v, "big") if v else default
+
+    def _flush(self) -> None:
+        fl = getattr(self.source, "flush", None)
+        if fl:
+            fl()
+
+    # ------------------------------------------------------------ writing
+
+    def log_intent(self, lo: int, hi: int, parent_root: bytes,
+                   expected_roots: List[bytes]) -> int:
+        """Durable BEFORE the caller mutates anything; returns the seq
+        for the matching ``log_commit``. Record first, head second: a
+        crash between the two orphans a record whose job never started
+        — recovery's tail..head scan correctly ignores it."""
+        if len(expected_roots) != hi - lo + 1:
+            raise ValueError("one expected root per block of the window")
+        with self._lock:
+            seq = self._get_int(_HEAD_KEY)
+            self.source.put(
+                _seq_key(_INTENT_PREFIX, seq),
+                rlp_encode([
+                    b"I", _int_bytes(seq), _int_bytes(lo), _int_bytes(hi),
+                    bytes(parent_root),
+                    [bytes(r) for r in expected_roots],
+                ]),
+            )
+            self.source.put(_HEAD_KEY, int(seq + 1).to_bytes(8, "big"))
+            self._flush()
+        return seq
+
+    def log_commit(self, seq: int) -> None:
+        """The window's blocks are saved and best advanced — or
+        recovery settled the intent (repair OR rollback); either way
+        the intent needs no further attention."""
+        with self._lock:
+            self.source.put(_seq_key(_COMMIT_PREFIX, seq), b"\x01")
+            self._flush()
+
+    # ------------------------------------------------------------ reading
+
+    def pending(self) -> List[IntentRecord]:
+        """Intents without a commit mark, ascending — the windows a
+        crash may have left half-persisted."""
+        out: List[IntentRecord] = []
+        with self._lock:
+            tail = self._get_int(_TAIL_KEY)
+            head = self._get_int(_HEAD_KEY)
+            for seq in range(tail, head):
+                raw = self.source.get(_seq_key(_INTENT_PREFIX, seq))
+                if raw is None:
+                    continue
+                if self.source.get(_seq_key(_COMMIT_PREFIX, seq)):
+                    continue
+                out.append(self._decode(raw))
+        return out
+
+    @staticmethod
+    def _decode(raw: bytes) -> IntentRecord:
+        tag, seq, lo, hi, parent_root, roots = rlp_decode(raw)
+        if tag != b"I":
+            raise ValueError(f"bad journal record tag {tag!r}")
+        return IntentRecord(
+            seq=int.from_bytes(seq, "big"),
+            lo=int.from_bytes(lo, "big"),
+            hi=int.from_bytes(hi, "big"),
+            parent_root=parent_root,
+            roots=list(roots),
+        )
+
+    def prune(self) -> int:
+        """Drop the settled prefix (intent+commit pairs below the first
+        pending intent); returns records removed. Bounds the journal to
+        O(in-flight windows)."""
+        removed = 0
+        with self._lock:
+            tail = self._get_int(_TAIL_KEY)
+            head = self._get_int(_HEAD_KEY)
+            seq = tail
+            while seq < head:
+                ik = _seq_key(_INTENT_PREFIX, seq)
+                if (self.source.get(ik) is not None
+                        and not self.source.get(
+                            _seq_key(_COMMIT_PREFIX, seq))):
+                    break  # first pending — stop
+                self.source.remove(ik)
+                self.source.remove(_seq_key(_COMMIT_PREFIX, seq))
+                removed += 1
+                seq += 1
+            if seq != tail:
+                self.source.put(_TAIL_KEY, int(seq).to_bytes(8, "big"))
+        return removed
+
+    @property
+    def depth(self) -> int:
+        """Live record span (head - tail) — a journal-health gauge."""
+        with self._lock:
+            return self._get_int(_HEAD_KEY) - self._get_int(_TAIL_KEY)
+
+
+# ------------------------------------------------------------- recovery
+
+
+@dataclass
+class RecoveryReport:
+    scanned: int = 0  # pending intents found
+    repaired: int = 0  # windows verified complete; mark restored
+    rolled_back: int = 0  # windows undone
+    blocks_removed: int = 0
+    missing_nodes: int = 0  # state-walk misses across failed verifies
+    corrupt_nodes: int = 0  # content-address mismatches found
+    best_before: int = 0
+    best_after: int = 0
+    actions: List[str] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return self.scanned == 0
+
+
+def recover(blockchain, log: Optional[Callable[[str], None]] = None
+            ) -> RecoveryReport:
+    """The startup pass (ReplayDriver.recover / ServiceBoard.__init__):
+    settle every pending intent — repair complete windows, roll back
+    partial ones, leave ``best_block_number`` on the last window whose
+    state fully verifies. Idempotent: a crash DURING recovery re-enters
+    the same scan."""
+    storages = blockchain.storages
+    journal = storages.window_journal
+    report = RecoveryReport(best_before=storages.app_state.best_block_number)
+    pending = journal.pending()
+    report.scanned = len(pending)
+    emit = log or (lambda s: None)
+    rollback_floor: Optional[int] = None  # first rolled-back lo
+
+    for rec in pending:
+        verified = False
+        if rollback_floor is None:
+            verified = _verify_window(blockchain, rec, report)
+        if verified:
+            journal.log_commit(rec.seq)
+            report.repaired += 1
+            report.actions.append(
+                f"window [{rec.lo}..{rec.hi}] verified complete; "
+                "commit mark restored"
+            )
+        else:
+            removed = _rollback_window(blockchain, rec)
+            journal.log_commit(rec.seq)  # settled by rollback
+            report.rolled_back += 1
+            report.blocks_removed += removed
+            if rollback_floor is None:
+                rollback_floor = rec.lo
+            report.actions.append(
+                f"window [{rec.lo}..{rec.hi}] rolled back "
+                f"({removed} partial block records removed)"
+            )
+
+    if rollback_floor is not None:
+        # best falls back to the last fully-committed window; the block
+        # sources already recomputed their best on remove
+        app_best = storages.app_state.best_block_number
+        new_best = min(app_best, rollback_floor - 1,
+                       max(0, storages.best_block_number))
+        storages.app_state.best_block_number = max(0, new_best)
+        report.actions.append(
+            f"best block rolled back {app_best} -> "
+            f"{storages.app_state.best_block_number}"
+        )
+    journal.prune()
+    report.best_after = storages.app_state.best_block_number
+    for line in report.actions:
+        emit(f"recover: {line}")
+    return report
+
+
+def _verify_window(blockchain, rec: IntentRecord,
+                   report: RecoveryReport) -> bool:
+    """Is the window FULLY persisted? Every block record present under
+    its expected root, and the state trie at the window's last root
+    reachable end-to-end with every node content-address clean."""
+    from khipu_tpu.storage.compactor import verify_reachable
+
+    s = blockchain.storages
+    for i, n in enumerate(range(rec.lo, rec.hi + 1)):
+        header = blockchain.get_header_by_number(n)
+        if header is None or header.state_root != rec.roots[i]:
+            return False
+        if (s.block_body_storage.get(n) is None
+                or s.receipts_storage.get(n) is None
+                or s.total_difficulty_storage.get(n) is None
+                or s.block_numbers.hash_of(n) != header.hash):
+            return False
+    walk = verify_reachable(
+        s.account_node_storage, s.storage_node_storage,
+        s.evmcode_storage, rec.roots[-1], verify_hashes=True,
+    )
+    report.missing_nodes += walk.missing
+    report.corrupt_nodes += walk.corrupt
+    return walk.missing == 0 and walk.corrupt == 0
+
+
+def _rollback_window(blockchain, rec: IntentRecord) -> int:
+    """Remove whatever block records the dead job managed to write.
+    Deliberately NOT Blockchain.remove_block: that needs a decodable
+    header+body pair, and a torn window may have either half missing."""
+    from khipu_tpu.domain.block import BlockBody
+
+    s = blockchain.storages
+    removed = 0
+    for n in range(rec.lo, rec.hi + 1):
+        header_raw = s.block_header_storage.get(n)
+        body_raw = s.block_body_storage.get(n)
+        if header_raw is None and body_raw is None \
+                and s.receipts_storage.get(n) is None:
+            continue
+        removed += 1
+        if body_raw is not None:
+            try:
+                for tx in BlockBody.decode(body_raw).transactions:
+                    s.transaction_storage.source.remove(tx.hash)
+            except Exception:
+                pass  # a torn body still gets its by-number records cut
+        if header_raw is not None:
+            h = s.block_numbers.hash_of(n)
+            if h is not None:
+                s.block_numbers.remove(h)
+        s.block_header_storage.source.remove(n)
+        s.block_body_storage.source.remove(n)
+        s.receipts_storage.source.remove(n)
+        s.total_difficulty_storage.source.remove(n)
+    return removed

@@ -17,13 +17,15 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, List, Optional
 
+from khipu_tpu.chaos import InjectedDeath, fault_point
+from khipu_tpu.chaos import apply_config as apply_fault_config
 from khipu_tpu.config import KhipuConfig
 from khipu_tpu.domain.block import Block
 from khipu_tpu.domain.blockchain import Blockchain
 from khipu_tpu.domain.difficulty import calc_difficulty
 from khipu_tpu.domain.transaction import recover_senders
 from khipu_tpu.ledger.ledger import execute_block
-from khipu_tpu.observability.trace import apply_config, span
+from khipu_tpu.observability.trace import apply_config, event, span
 from khipu_tpu.validators.validators import (
     BlockHeaderValidator,
     BlockValidator,
@@ -41,7 +43,18 @@ PIPELINE_GAUGES = {
     "occupancy": 0.0,  # driver/collector overlap fraction, last run
     "driver_stall_s": 0.0,  # driver seconds blocked on backpressure
     "collector_busy_s": 0.0,  # background collect+save busy seconds
+    "collector_deaths": 0,  # dead workers detected by liveness checks
+    "sync_fallback_windows": 0,  # windows committed synchronously after
+    # a collector death (graceful degradation — docs/recovery.md)
 }
+
+
+class CollectorDied(RuntimeError):
+    """The background collector thread is no longer alive but never
+    recorded a failure — a simulated (chaos ``die``) or real
+    (interpreter-level) death mid-job. Detected by the timed liveness
+    checks in submit/drain instead of hanging on the condition
+    variable forever."""
 
 
 @dataclass
@@ -88,12 +101,19 @@ class _WindowCollector:
     thread at its next submit/drain, so a mismatch still names the
     failing block number."""
 
-    def __init__(self, depth: int):
+    def __init__(self, depth: int, join_timeout: float = 60.0,
+                 liveness_poll: float = 0.1):
         self.depth = max(1, depth)
         self.busy_seconds = 0.0
+        self.join_timeout = join_timeout
+        # backpressure/drain waits wake at this period to re-check the
+        # worker is still alive — a dead thread can never notify, so an
+        # untimed wait would hang the driver forever
+        self.liveness_poll = liveness_poll
         self._cv = threading.Condition()
         self._q: deque = deque()
         self._active = False
+        self._current: Optional[Callable[[], None]] = None
         self._failure: Optional[BaseException] = None
         self._closed = False
         self._thread = threading.Thread(
@@ -103,14 +123,29 @@ class _WindowCollector:
 
     # ------------------------------------------------------- driver side
 
+    def _check_liveness(self) -> None:
+        """Call under ``_cv``. A worker that exited without recording a
+        failure and without being closed died mid-job (chaos ``die`` or
+        a real interpreter-level death) — raise instead of waiting on
+        notifies that will never come."""
+        if (self._failure is None and not self._closed
+                and not self._thread.is_alive()):
+            raise CollectorDied(
+                "window-collector thread died mid-job "
+                f"({len(self._q)} queued, active={self._active})"
+            )
+
     def submit(self, fn: Callable[[], None]) -> float:
         """Queue one job; returns driver seconds stalled on
-        backpressure. Re-raises the collector's failure, if any."""
+        backpressure. Re-raises the collector's failure, if any;
+        raises CollectorDied when the worker is gone."""
         t0 = time.perf_counter()
         with self._cv:
+            self._check_liveness()
             while (self._failure is None and not self._closed
                    and len(self._q) + self._active >= self.depth):
-                self._cv.wait()
+                self._cv.wait(timeout=self.liveness_poll)
+                self._check_liveness()
             if self._failure is not None:
                 raise self._failure
             if self._closed:
@@ -123,32 +158,71 @@ class _WindowCollector:
 
     def drain(self) -> float:
         """Wait until every queued job has completed; returns driver
-        seconds stalled. Re-raises the collector's failure, if any."""
+        seconds stalled. Re-raises the collector's failure, if any;
+        raises CollectorDied when the worker is gone."""
         t0 = time.perf_counter()
         with self._cv:
+            self._check_liveness()
             while self._failure is None and (self._q or self._active):
-                self._cv.wait()
+                self._cv.wait(timeout=self.liveness_poll)
+                self._check_liveness()
             if self._failure is not None:
                 raise self._failure
         return time.perf_counter() - t0
 
+    def take_pending(self) -> List[Callable[[], None]]:
+        """After CollectorDied: the dead worker's unfinished jobs in
+        FIFO order — the partially-executed current job FIRST (jobs are
+        idempotent: node puts are content-addressed, block saves
+        overwrite by number, stats apply only at job end). Marks the
+        collector closed; the caller runs these synchronously."""
+        with self._cv:
+            fns: List[Callable[[], None]] = []
+            if self._active and self._current is not None:
+                fns.append(self._current)
+            fns.extend(self._q)
+            self._q.clear()
+            self._closed = True
+            PIPELINE_GAUGES["in_flight"] = 0
+            self._cv.notify_all()
+        return fns
+
     def close(self) -> None:
         """Stop the worker (after finishing anything queued) and join.
-        Safe to call twice."""
+        Safe to call twice. Raises if the worker is still alive after
+        ``join_timeout`` — a wedged job must not be silently abandoned
+        with the pipeline's windows unaccounted for."""
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        self._thread.join(timeout=60.0)
+        self._thread.join(timeout=self.join_timeout)
+        if self._thread.is_alive():
+            raise RuntimeError(
+                "window-collector failed to stop within "
+                f"{self.join_timeout:.0f}s — a wedged job is still "
+                "holding the pipeline (its windows are NOT committed)"
+            )
 
     def kill(self) -> None:
         """Abort: drop queued jobs WITHOUT running them (nothing else
         persists) and join. The driver calls this when IT failed —
-        windows sealed after the failing block must not be committed."""
+        windows sealed after the failing block must not be committed.
+        Already unwinding, so a wedged worker is logged loudly instead
+        of raised over the original failure."""
         with self._cv:
             self._q.clear()
             self._closed = True
             self._cv.notify_all()
-        self._thread.join(timeout=60.0)
+        self._thread.join(timeout=self.join_timeout)
+        if self._thread.is_alive():
+            import sys
+
+            print(
+                "WARNING: window-collector did not stop within "
+                f"{self.join_timeout:.0f}s of kill(); abandoning the "
+                "wedged daemon thread",
+                file=sys.stderr,
+            )
 
     # ------------------------------------------------------- worker side
 
@@ -163,15 +237,24 @@ class _WindowCollector:
                 ):
                     return
                 fn = self._q.popleft()
+                self._current = fn
                 self._active = True
                 PIPELINE_GAUGES["in_flight"] = len(self._q) + 1
             t0 = time.perf_counter()
             try:
                 fn()
+            except InjectedDeath:
+                # simulated process death (chaos `die`): no failure
+                # record, no notify — the thread just stops with the
+                # job half done, exactly like a SIGKILL. The driver's
+                # liveness checks raise CollectorDied; _current stays
+                # set so take_pending can re-run the torn job.
+                return
             except BaseException as exc:  # surfaces on the driver
                 with self._cv:
                     self._failure = exc
                     self._active = False
+                    self._current = None
                     self._q.clear()  # abort: NOTHING else persists
                     PIPELINE_GAUGES["in_flight"] = 0
                     self._cv.notify_all()
@@ -180,6 +263,7 @@ class _WindowCollector:
             with self._cv:
                 self.busy_seconds += dt
                 self._active = False
+                self._current = None
                 PIPELINE_GAUGES["windows_collected"] += 1
                 PIPELINE_GAUGES["in_flight"] = len(self._q)
                 PIPELINE_GAUGES["collector_busy_s"] = self.busy_seconds
@@ -200,6 +284,7 @@ class ReplayDriver:
         self.blockchain = blockchain
         self.config = config
         apply_config(config.observability)
+        apply_fault_config(getattr(config, "faults", None))
         self.log = log
         self.header_validator = BlockHeaderValidator(
             config.blockchain,
@@ -220,6 +305,14 @@ class ReplayDriver:
             self.hasher = device_hasher
         else:
             self.hasher = None
+
+    def recover(self):
+        """Crash-recovery startup pass (sync/journal.py): settle every
+        pending window-commit intent — repair complete windows, roll
+        back partial ones. Returns a RecoveryReport."""
+        from khipu_tpu.sync.journal import recover
+
+        return recover(self.blockchain, log=self.log)
 
     def replay(self, blocks: Iterable[Block]) -> ReplayStats:
         """executeAndInsertBlocks: serial fold with full validation."""
@@ -293,8 +386,73 @@ class ReplayDriver:
 
         committer = make_committer(parent.state_root)
         depth = max(1, self.config.sync.pipeline_depth)
-        collector = _WindowCollector(depth)
+        collector = _WindowCollector(
+            depth, join_timeout=self.config.sync.collector_join_timeout
+        )
         PIPELINE_GAUGES["depth"] = depth
+        # crash consistency: WAL intent before each background job, a
+        # commit mark after its best-number advance (docs/recovery.md)
+        journal = (
+            self.blockchain.storages.window_journal
+            if self.config.sync.commit_journal else None
+        )
+        window_parent_root = parent.state_root
+        # graceful degradation: a dead collector thread (CollectorDied
+        # from the liveness checks) switches the driver to synchronous
+        # commits instead of aborting — unless config says abort
+        sync_degraded = False
+        degrade_on_death = self.config.sync.degrade_on_collector_death
+
+        def _degrade() -> None:
+            nonlocal sync_degraded
+            sync_degraded = True
+            PIPELINE_GAUGES["collector_deaths"] += 1
+            event("pipeline.degrade", reason="collector-died")
+            if self.log is not None:
+                self.log(
+                    "window-collector thread died; degrading to "
+                    "synchronous window commits (jobs are idempotent "
+                    "— re-running the torn one)"
+                )
+            for fn in collector.take_pending():
+                PIPELINE_GAUGES["sync_fallback_windows"] += 1
+                fn()
+
+        def submit_job(run_fn) -> float:
+            if sync_degraded:
+                PIPELINE_GAUGES["sync_fallback_windows"] += 1
+                run_fn()
+                if journal is not None:
+                    journal.prune()
+                return 0.0
+            try:
+                return collector.submit(run_fn)
+            except CollectorDied:
+                if not degrade_on_death:
+                    raise
+                _degrade()
+                PIPELINE_GAUGES["sync_fallback_windows"] += 1
+                run_fn()
+                return 0.0
+
+        def drain_pipeline() -> float:
+            # with the pipeline empty every intent is settled: drop the
+            # committed prefix so the journal stays O(pipeline_depth),
+            # not O(chain)
+            if sync_degraded:
+                if journal is not None:
+                    journal.prune()
+                return 0.0
+            try:
+                stall = collector.drain()
+            except CollectorDied:
+                if not degrade_on_death:
+                    raise
+                _degrade()
+                return 0.0
+            if journal is not None:
+                journal.prune()
+            return stall
         # epoch reset: every N blocks the session committer is rebuilt
         # from the last VALIDATED root, dropping the resolved-
         # placeholder map and all retained refs — with the per-collect
@@ -304,7 +462,8 @@ class ReplayDriver:
         epoch = self.session_epoch_blocks
         blocks_since_reset = 0
 
-        def make_collect_job(cm: WindowCommitter, job, results, seal_tok):
+        def make_collect_job(cm: WindowCommitter, job, results, seal_tok,
+                             intent_seq):
             # runs ON THE COLLECTOR THREAD, strictly FIFO. ``seal_tok``
             # (the driver's window.seal span id) rides the closure across
             # the queue so the trace links the collector's spans to the
@@ -313,12 +472,17 @@ class ReplayDriver:
             lo, hi = results[0][0].number, results[-1][0].number
 
             def run():
+                # chaos seams: a rule at any of the collector.* sites
+                # models a failure/death at that phase of the job
+                # (docs/recovery.md crash-point table)
+                fault_point("collector.collect")
                 t0 = time.perf_counter()
                 with span("window.collect", parent=seal_tok,
                           block_lo=lo, block_hi=hi):
                     cm.collect(job)  # raises WindowMismatch on divergence
                 t1 = time.perf_counter()
-                ph["collect_bg"] += t1 - t0
+                fault_point("collector.persist")
+                blocks = txs = gas = ptxs = confl = 0
                 with span("window.persist", parent=seal_tok,
                           block_lo=lo, block_hi=hi, blocks=len(results)):
                     for block, result in results:
@@ -333,20 +497,66 @@ class ReplayDriver:
                         self.blockchain.save_block(
                             block, result.receipts, td, world=None
                         )
-                        stats.blocks += 1
-                        stats.txs += result.stats.tx_count
-                        stats.gas += result.gas_used
-                        stats.parallel_txs += result.stats.parallel_count
-                        stats.conflicts += result.stats.conflict_count
+                        fault_point("collector.save")
+                        blocks += 1
+                        txs += result.stats.tx_count
+                        gas += result.gas_used
+                        ptxs += result.stats.parallel_count
+                        confl += result.stats.conflict_count
+                    # the commit mark is the job's LAST mutation, and
+                    # it is persistence work: keeping it inside the
+                    # persist span keeps span-recomputed occupancy in
+                    # agreement with the busy-seconds gauge
+                    if intent_seq is not None:
+                        fault_point("collector.commit")
+                        journal.log_commit(intent_seq)
                     if self.log is not None:
                         self.log(
                             f"Committed window [{lo}..{hi}] "
                             f"({len(results)} blocks) in one batched "
                             "device pass"
                         )
-                ph["save_bg"] += time.perf_counter() - t1
+                    # stats land ONLY here, after the commit mark: a
+                    # torn job re-run after a collector death stays
+                    # idempotent — no double counting (nothing below
+                    # can raise before they apply)
+                    stats.blocks += blocks
+                    stats.txs += txs
+                    stats.gas += gas
+                    stats.parallel_txs += ptxs
+                    stats.conflicts += confl
+                t2 = time.perf_counter()
+                ph["collect_bg"] += t1 - t0
+                ph["save_bg"] += t2 - t1
 
             return run
+
+        def seal_and_submit() -> None:
+            nonlocal results_cur, window_parent_root
+            lo = results_cur[0][0].number
+            hi = results_cur[-1][0].number
+            t0 = time.perf_counter()
+            intent_seq = None
+            with span("window.seal", block_lo=lo, block_hi=hi) as seal_sp:
+                job = committer.seal()
+                if journal is not None:
+                    # WAL barrier: the intent is durable BEFORE the job
+                    # can run (submit enqueues it strictly afterwards).
+                    # It is part of sealing — inside the span, so the
+                    # driver phase accounting sees the journal cost.
+                    intent_seq = journal.log_intent(
+                        lo, hi, window_parent_root,
+                        [b.header.state_root for b, _ in results_cur],
+                    )
+            ph["seal"] += time.perf_counter() - t0
+            run_fn = make_collect_job(
+                committer, job, results_cur, seal_sp.token, intent_seq
+            )
+            with span("pipeline.stall", block_lo=lo, block_hi=hi,
+                      kind="submit"):
+                ph["collect"] += submit_job(run_fn)
+            window_parent_root = results_cur[-1][0].header.state_root
+            results_cur = []
 
         results_cur: List = []
         prev = parent
@@ -411,31 +621,12 @@ class ReplayDriver:
                     # input tiles); the only wait is submit backpressure
                     # once pipeline_depth windows are queued
                     blocks_since_reset += len(results_cur)
-                    lo = results_cur[0][0].number
-                    hi = results_cur[-1][0].number
-                    t0 = time.perf_counter()
-                    with span(
-                        "window.seal", block_lo=lo, block_hi=hi
-                    ) as seal_sp:
-                        job = committer.seal()
-                    ph["seal"] += time.perf_counter() - t0
-                    with span(
-                        "pipeline.stall", block_lo=lo, block_hi=hi,
-                        kind="submit",
-                    ):
-                        stalled = collector.submit(
-                            make_collect_job(
-                                committer, job, results_cur,
-                                seal_sp.token,
-                            )
-                        )
-                    ph["collect"] += stalled
-                    results_cur = []
+                    seal_and_submit()
                     if blocks_since_reset >= epoch:
                         # drain the pipeline, then restart the session from
                         # the last validated root (memory bound)
                         with span("pipeline.stall", kind="epoch-drain"):
-                            stalled = collector.drain()
+                            stalled = drain_pipeline()
                         ph["collect"] += stalled
                         committer = make_committer(prev.state_root)
                         blocks_since_reset = 0
@@ -449,26 +640,9 @@ class ReplayDriver:
                             for n in sorted(d)[:-keep]:
                                 del d[n]
             if results_cur:
-                lo = results_cur[0][0].number
-                hi = results_cur[-1][0].number
-                t0 = time.perf_counter()
-                with span(
-                    "window.seal", block_lo=lo, block_hi=hi
-                ) as seal_sp:
-                    job = committer.seal()
-                ph["seal"] += time.perf_counter() - t0
-                with span(
-                    "pipeline.stall", block_lo=lo, block_hi=hi,
-                    kind="submit",
-                ):
-                    stalled = collector.submit(
-                        make_collect_job(
-                            committer, job, results_cur, seal_sp.token
-                        )
-                    )
-                ph["collect"] += stalled
+                seal_and_submit()
             with span("pipeline.stall", kind="final-drain"):
-                stalled = collector.drain()
+                stalled = drain_pipeline()
             ph["collect"] += stalled
         except BaseException:
             # a driver-side failure (validation, execution, or a

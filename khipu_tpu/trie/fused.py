@@ -262,6 +262,9 @@ class FusedJob:
             return {}
         import jax
 
+        from khipu_tpu.chaos import fault_point
+
+        fault_point("fused.collect")
         with _span("fused.collect", rows=int(self.digests.shape[0])):
             d = np.asarray(jax.device_get(self.digests))
             # ONE device fetch, ONE bytes copy, then pure slicing — the
@@ -322,6 +325,11 @@ def fused_submit(
     a window can be sealed and dispatched while its predecessor is
     still hashing (the seal/collect barrier removal).
     """
+    from khipu_tpu.chaos import fault_point
+
+    # chaos seam: a `raise` rule here models a runtime device-dispatch
+    # failure (window.py degrades that window to the host hasher)
+    fault_point("fused.dispatch")
     with _span(
         "fused.dispatch",
         nodes=len(to_resolve),
