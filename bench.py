@@ -127,6 +127,7 @@ def _trace_report(stats):
     must land within a few percent of stats.seconds; the smoke test
     asserts exactly that."""
     from khipu_tpu.observability import recorder
+    from khipu_tpu.observability.registry import REGISTRY
     from khipu_tpu.observability.trace import tracer
 
     spans = tracer.snapshot()
@@ -145,6 +146,15 @@ def _trace_report(stats):
         "dropped": tracer.dropped,
         "compile_cache": {
             k: log[k] for k in ("hits", "misses", "evictions")
+        },
+        # the unified-registry view of the same run: family count plus
+        # the recorder-fed phase-latency histogram totals — the smoke
+        # test cross-checks these against the text exposition
+        "registry_families": len(REGISTRY.snapshot()),
+        "phase_observations": {
+            k: h.value["count"]
+            for k, h in recorder.PHASE_HISTOGRAMS.items()
+            if h.value["count"]
         },
     }
 

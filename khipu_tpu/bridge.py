@@ -21,12 +21,28 @@ Methods (all request/response = opaque bytes):
                  each node on every replica of its key so the cluster
                  keeps serving it when one shard dies. Values are
                  content-address verified before admission.
-  Ping:          x -> x
+  Ping:          x -> x, EXCEPT the clock-probe sentinel
+                 (``CLOCK_PROBE``) which answers rlp(shard_wall_us_be)
+                 — the NTP-style offset/RTT estimate the merged chrome
+                 trace is built on (observability/export.py)
+  GetTraceSpans: b"" -> rlp([trace_id, [span...]]) — the shard's span
+                 ring, each span
+                 [sid, parent|"" , name, t0_wall_us, t1_wall_us, tid,
+                  thread_name, error|"", tags_json] with ABSOLUTE
+                 shard-wall microsecond stamps
+
+Trace propagation (Dapper-style): every BridgeClient call carries
+``khipu-trace-id`` / ``khipu-parent-token`` / ``khipu-sampled`` gRPC
+metadata; the server opens a ``bridge.serve.<Method>`` span in its OWN
+tracer ring tagged with the remote linkage, so the driver can pull the
+ring and nest shard work under the exact RPC span that caused it.
 """
 
 from __future__ import annotations
 
+import json
 import threading
+import time
 from concurrent import futures
 from typing import List, Optional
 
@@ -38,23 +54,94 @@ from khipu_tpu.config import KhipuConfig
 from khipu_tpu.domain.block import Block
 from khipu_tpu.domain.blockchain import Blockchain
 from khipu_tpu.evm.dataword import from_bytes, to_minimal_bytes
+from khipu_tpu.observability.trace import (
+    Tracer,
+    apply_config as apply_trace_config,
+    current_tracer,
+    use_tracer,
+)
 
 SERVICE = "khipu.Bridge"
+
+# gRPC metadata keys the client attaches on EVERY call (values are the
+# caller's tracer identity; khipu-sampled="0" still ships the keys so
+# the wire format is unconditional and greppable)
+MD_TRACE_ID = "khipu-trace-id"
+MD_PARENT_TOKEN = "khipu-parent-token"
+MD_SAMPLED = "khipu-sampled"
+
+# Ping clock-probe sentinel: any other payload echoes verbatim (pure
+# Ping semantics preserved); this one answers the shard's wall clock in
+# microseconds so one timed Ping yields (offset, rtt)
+CLOCK_PROBE = b"\x00khipu-clock-probe\x00"
 
 
 def _identity(b: bytes) -> bytes:
     return b
 
 
+def _encode_trace_spans(tracer_: Tracer) -> bytes:
+    """The GetTraceSpans response: the ring as RLP with absolute
+    shard-wall microsecond stamps (the driver re-anchors them with the
+    Ping offset estimate). Tags ship as JSON — values are display-only
+    on the far side; bytes become hex."""
+    rows = []
+    for s in tracer_.snapshot():
+        tags = {
+            k: (v.hex() if isinstance(v, bytes) else v)
+            for k, v in s.tags.items()
+        }
+        rows.append([
+            to_minimal_bytes(s.sid),
+            to_minimal_bytes(s.parent) if s.parent else b"",
+            s.name.encode(),
+            to_minimal_bytes(int(tracer_.to_wall(s.t0) * 1e6)),
+            to_minimal_bytes(int(tracer_.to_wall(s.t1) * 1e6)),
+            to_minimal_bytes(s.tid),
+            (s.thread_name or "").encode(),
+            b"\x01" if s.error else b"",
+            json.dumps(tags).encode(),
+        ])
+    return rlp_encode([tracer_.trace_id.encode(), rows])
+
+
+def decode_trace_spans(payload: bytes) -> dict:
+    """Inverse of ``_encode_trace_spans``: {traceId, spans:[{...}]}
+    with ``t0_wall``/``t1_wall`` back in float seconds."""
+    trace_id, rows = rlp_decode(payload)
+    spans = []
+    for row in rows:
+        (sid, parent, name, t0, t1, tid, tname, err, tags) = row
+        spans.append({
+            "sid": from_bytes(sid),
+            "parent": from_bytes(parent) if parent else None,
+            "name": name.decode(),
+            "t0_wall": from_bytes(t0) / 1e6,
+            "t1_wall": from_bytes(t1) / 1e6,
+            "tid": from_bytes(tid),
+            "thread_name": tname.decode(),
+            "error": bool(err),
+            "tags": json.loads(tags.decode() or "{}"),
+        })
+    return {"traceId": trace_id.decode(), "spans": spans}
+
+
 class BridgeServer:
     def __init__(self, blockchain: Blockchain, config: KhipuConfig,
-                 device_commit: bool = False, max_workers: int = 4):
+                 device_commit: bool = False, max_workers: int = 4,
+                 tracer: Optional[Tracer] = None):
         self.blockchain = blockchain
         self.config = config
         self.device_commit = device_commit
         self.max_workers = max_workers
         self._exec_lock = threading.Lock()  # blocks apply serially
         self._server: Optional[grpc.Server] = None
+        # the SHARD's own span ring (per-instance: two in-process
+        # servers — the 2-shard tests — must not interleave rings),
+        # served raw over GetTraceSpans. Enabled by config or by the
+        # operator poking ``server.tracer.enable()``.
+        self.tracer = tracer if tracer is not None else Tracer()
+        apply_trace_config(config.observability, self.tracer)
 
     # ------------------------------------------------------------ methods
 
@@ -72,6 +159,7 @@ class BridgeServer:
             driver = ReplayDriver(
                 self.blockchain, self.config,
                 device_commit=self.device_commit,
+                tracer=self.tracer,
             )
             try:
                 driver.replay(blocks)
@@ -140,7 +228,17 @@ class BridgeServer:
         return rlp_encode(to_minimal_bytes(admitted))
 
     def _ping(self, request: bytes, context) -> bytes:
+        if request == CLOCK_PROBE:
+            # shard wall clock, anchored through the tracer epoch so a
+            # test can inject a known offset by shifting epoch_wall —
+            # spans and probe answers then shift together, exactly like
+            # a skewed host clock would
+            now = self.tracer.to_wall(time.perf_counter())
+            return rlp_encode(to_minimal_bytes(int(now * 1e6)))
         return request
+
+    def _get_trace_spans(self, request: bytes, context) -> bytes:
+        return _encode_trace_spans(self.tracer)
 
     # ------------------------------------------------------------- server
 
@@ -151,7 +249,23 @@ class BridgeServer:
             # a `raise` rule a shard-side failure
             def handler(request, context):
                 fault_point(f"bridge.serve.{name}")
-                return fn(request, context)
+                tr = self.tracer
+                if not tr.enabled:
+                    return fn(request, context)
+                # server-side span, linked to the remote parent from
+                # the propagated metadata (tags, not a local parent id
+                # — the token lives in the CALLER's id space)
+                tags = {"method": name}
+                md = dict(context.invocation_metadata() or ())
+                if md.get(MD_SAMPLED) == "1":
+                    tags["remote_trace"] = md.get(MD_TRACE_ID, "")
+                    tok = md.get(MD_PARENT_TOKEN, "")
+                    if tok.isdigit():
+                        tags["remote_parent"] = int(tok)
+                with use_tracer(tr), tr.span(
+                    f"bridge.serve.{name}", **tags
+                ):
+                    return fn(request, context)
 
             return grpc.unary_unary_rpc_method_handler(
                 handler, _identity, _identity
@@ -168,6 +282,9 @@ class BridgeServer:
             "GetNodeData": _guarded("GetNodeData", self._get_node_data),
             "PutNodeData": _guarded("PutNodeData", self._put_node_data),
             "Ping": _guarded("Ping", self._ping),
+            "GetTraceSpans": _guarded(
+                "GetTraceSpans", self._get_trace_spans
+            ),
         }
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=self.max_workers)
@@ -203,7 +320,20 @@ class BridgeClient:
             request_serializer=_identity,
             response_deserializer=_identity,
         )
-        return fn(payload, timeout=self.deadline)
+        # Dapper propagation: the caller's tracer identity + innermost
+        # span token ride as gRPC metadata on EVERY call (sampled="0"
+        # when tracing is off — the keys are unconditional). The
+        # ``bridge.call`` span is the client half of the RPC edge; its
+        # token is what the server records as remote_parent, so the
+        # merged trace nests the server span inside exactly this one.
+        t = current_tracer()
+        with t.span("bridge.call", method=method) as sp:
+            md = (
+                (MD_TRACE_ID, t.trace_id),
+                (MD_PARENT_TOKEN, str(sp.token or "")),
+                (MD_SAMPLED, "1" if t.enabled else "0"),
+            )
+            return fn(payload, timeout=self.deadline, metadata=md)
 
     def execute_blocks(self, blocks: List[Block]):
         payload = rlp_encode(
@@ -256,6 +386,30 @@ class BridgeClient:
 
     def ping(self, payload: bytes = b"ping") -> bytes:
         return self._call("Ping", payload)
+
+    def clock_probe(self, samples: int = 5):
+        """NTP-style clock estimate from timed Ping probes: returns
+        ``(offset_s, rtt_s)`` for the MINIMUM-RTT probe, where
+        ``offset = shard_clock - local_clock`` and the true offset lies
+        within ±rtt/2 of the estimate (the shard stamped its clock
+        somewhere inside the round trip; the midpoint assumption is off
+        by at most half of it)."""
+        best = None
+        for _ in range(max(1, samples)):
+            t0 = time.time()
+            out = self._call("Ping", CLOCK_PROBE)
+            t1 = time.time()
+            shard_s = from_bytes(rlp_decode(out)) / 1e6
+            rtt = max(0.0, t1 - t0)
+            offset = shard_s - (t0 + t1) / 2.0
+            if best is None or rtt < best[1]:
+                best = (offset, rtt)
+        return best
+
+    def get_trace_spans(self) -> dict:
+        """Pull the shard's span ring: {traceId, spans:[{...}]} with
+        absolute shard-wall second stamps (see decode_trace_spans)."""
+        return decode_trace_spans(self._call("GetTraceSpans", b""))
 
     def close(self) -> None:
         self.channel.close()

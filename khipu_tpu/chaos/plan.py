@@ -145,6 +145,29 @@ class FaultLog:
 fault_log = FaultLog()
 
 
+def _fault_samples() -> list:
+    """Registry collector: fired-fault counters as khipu_chaos_* —
+    total unlabeled, per-kind and per-site labeled families."""
+    snap = fault_log.snapshot()
+    out = [("khipu_chaos_faults_fired_total", "counter", {},
+            snap["fired"])]
+    for kind, n in sorted(snap["byKind"].items()):
+        out.append(("khipu_chaos_faults_by_kind_total", "counter",
+                    {"kind": kind}, n))
+    for site, n in sorted(snap["bySite"].items()):
+        out.append(("khipu_chaos_faults_by_site_total", "counter",
+                    {"site": site}, n))
+    return out
+
+
+try:
+    from khipu_tpu.observability.registry import REGISTRY
+
+    REGISTRY.register_collector("chaos", _fault_samples)
+except Exception:  # pragma: no cover - registry is stdlib-only
+    pass
+
+
 class FaultPlan:
     """A seeded set of rules evaluated at every seam hit.
 

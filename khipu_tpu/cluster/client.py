@@ -187,6 +187,17 @@ class ShardedNodeClient:
         self._missed: Dict[str, Dict[bytes, None]] = {}
         self._missed_total = 0
         self._missed_lock = threading.Lock()
+        # unified-registry pull source: the newest client owns the
+        # process's cluster telemetry slot (replace-by-key — tests
+        # build many short-lived clients)
+        try:
+            from khipu_tpu.observability.registry import REGISTRY
+
+            REGISTRY.register_collector(
+                "cluster", self._registry_samples
+            )
+        except Exception:
+            pass
 
     # -------------------------------------------------------- transport
 
@@ -448,6 +459,64 @@ class ShardedNodeClient:
                 for ep, m in self.metrics.items()
             },
         }
+
+    def _registry_samples(self) -> list:
+        """The same counters as ``metrics_snapshot``, flattened into
+        registry sample tuples — per-endpoint families labeled
+        ``{endpoint=...}``, cluster-wide ones unlabeled."""
+        alive = set(self.ring.members)
+        out = [
+            ("khipu_cluster_local_fallbacks_total", "counter", {},
+             self.local_fallbacks),
+            ("khipu_cluster_unreachable_total", "counter", {},
+             self.unreachable),
+            ("khipu_cluster_missed_keys", "gauge", {},
+             self._missed_total),
+            ("khipu_cluster_missed_dropped_total", "counter", {},
+             self.missed_dropped),
+            ("khipu_cluster_members", "gauge", {},
+             len(self.ring.members)),
+        ]
+        per_ep = (
+            ("khipu_shard_requests_total", "counter", "requests"),
+            ("khipu_shard_served_total", "counter", "served"),
+            ("khipu_shard_missing_total", "counter", "missing"),
+            ("khipu_shard_corrupt_total", "counter", "corrupt"),
+            ("khipu_shard_failures_total", "counter", "failures"),
+            ("khipu_shard_failovers_total", "counter", "failovers"),
+            ("khipu_shard_replicated_total", "counter", "replicated"),
+            ("khipu_shard_backfilled_total", "counter", "backfilled"),
+        )
+        for ep, m in self.metrics.items():
+            lb = {"endpoint": ep}
+            for name, kind, attr in per_ep:
+                out.append((name, kind, lb, getattr(m, attr)))
+            out.append((
+                "khipu_shard_latency_seconds_total", "counter", lb,
+                round(m.latency_ns / 1e9, 6),
+            ))
+            out.append(
+                ("khipu_shard_alive", "gauge", lb, int(ep in alive))
+            )
+        return out
+
+    def collect_traces(self, probe_samples: int = 5) -> list:
+        """Pull every live shard's span ring + clock estimate (the
+        ``merged_chrome_trace`` input; observability/export.py). Shards
+        whose channel lacks the trace RPCs — or that fail mid-pull —
+        are skipped: a trace dump must never take the cluster down."""
+        from khipu_tpu.observability.export import shard_timeline
+
+        shards = []
+        for ep in list(self.ring.members):
+            try:
+                shards.append(shard_timeline(
+                    self._channel(ep), endpoint=ep,
+                    probe_samples=probe_samples,
+                ))
+            except Exception:
+                continue
+        return shards
 
     def close(self) -> None:
         with self._channel_lock:

@@ -57,6 +57,7 @@ class EthService:
         config: KhipuConfig,
         tx_pool: Optional[PendingTransactionsPool] = None,
         cluster=None,
+        tracer=None,
     ):
         self.blockchain = blockchain
         self.config = config
@@ -64,11 +65,44 @@ class EthService:
         # sharded node-cache cluster client (cluster/client.py); when
         # set, khipu_metrics surfaces its per-shard counters
         self.cluster = cluster
+        # the flight recorder the khipu_traces / khipu_dump_chrome_trace
+        # RPCs serve from (a board-owned instance when embedded in a
+        # ServiceBoard; the process default otherwise)
+        if tracer is None:
+            from khipu_tpu.observability.trace import tracer
+        self.tracer = tracer
         from khipu_tpu.jsonrpc.filters import FilterManager
 
         # eager: a lazy-init race under concurrent RPC threads could
         # orphan one client's installed filter ids
         self._filter_manager = FilterManager(blockchain)
+        # chain-head + store-cache samples for the unified registry
+        # (replace-by-key: the newest service owns the slot)
+        try:
+            from khipu_tpu.observability.registry import REGISTRY
+
+            REGISTRY.register_collector("chain", self._registry_samples)
+        except Exception:
+            pass
+
+    def _registry_samples(self) -> list:
+        s = self.blockchain.storages
+        out = [
+            ("khipu_best_block_number", "gauge", {},
+             self.blockchain.best_block_number),
+            ("khipu_pending_txs", "gauge", {}, len(self.tx_pool)),
+        ]
+        for name, store in (
+            ("account", s.account_node_storage),
+            ("storage", s.storage_node_storage),
+            ("evmcode", s.evmcode_storage),
+        ):
+            lb = {"store": name}
+            out.append(("khipu_store_cache_hit_rate", "gauge", lb,
+                        round(store.cache_hit_rate, 4)))
+            out.append(("khipu_store_cache_reads_total", "counter", lb,
+                        store.cache_read_count))
+        return out
 
     # ------------------------------------------------------- block tags
 
@@ -517,7 +551,34 @@ class EthService:
             ),
             "faults": fault_log.snapshot(),
         }
+        # the unified-registry superset: every registered instrument +
+        # pull collector in one consistent snapshot (the same samples
+        # khipu_metrics_text exposes), plus the per-phase latency
+        # histograms the recorder feeds, flattened for dashboards
+        from khipu_tpu.observability.registry import REGISTRY
+
+        reg = REGISTRY.snapshot()
+        out["registry"] = reg
+        hist = reg.get("khipu_phase_latency_seconds")
+        out["phaseLatency"] = {}
+        if isinstance(hist, dict):
+            for lk, v in hist.items():
+                if not isinstance(v, dict):
+                    continue
+                phase = lk.split('"')[1] if '"' in lk else lk
+                out["phaseLatency"][phase] = {
+                    "count": v["count"],
+                    "sumSeconds": v["sum"],
+                }
         return out
+
+    def khipu_metrics_text(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of the unified
+        registry — the same samples ``khipu_metrics`` serves under
+        ``registry``, as a scraper-ready document."""
+        from khipu_tpu.observability.registry import REGISTRY
+
+        return REGISTRY.prometheus_text()
 
     def khipu_traces(self) -> dict:
         """Flight-recorder summary (observability/export.snapshot):
@@ -525,7 +586,7 @@ class EthService:
         percentiles, occupancy timeline and compile-cache pressure."""
         from khipu_tpu.observability import export
 
-        return export.snapshot()
+        return export.snapshot(tracer_=self.tracer)
 
     def khipu_trace_block(self, number) -> dict:
         """Full lifecycle record of ONE block: every span tagged with
@@ -535,17 +596,31 @@ class EthService:
         from khipu_tpu.observability import export
 
         n = parse_qty(number) if isinstance(number, str) else int(number)
-        return export.trace_block(n)
+        return export.trace_block(n, tracer_=self.tracer)
 
     def khipu_dump_chrome_trace(self, path: str) -> dict:
         """Write the ring's spans as Chrome trace_event JSON (load in
-        perfetto / chrome://tracing); returns {path, spans}."""
+        perfetto / chrome://tracing); returns {path, spans, shards}.
+        With a cluster attached, every reachable shard's span ring is
+        pulled over the bridge and merged onto the driver timeline
+        (offset-corrected — observability/export.merged_chrome_trace),
+        so the dump is ONE nested driver -> bridge -> shard trace."""
         from khipu_tpu.observability import export
-        from khipu_tpu.observability.trace import tracer
 
-        spans = tracer.snapshot()
-        export.dump_chrome_trace(path, spans)
-        return {"path": path, "spans": len(spans)}
+        spans = self.tracer.snapshot()
+        shards = []
+        if self.cluster is not None:
+            try:
+                shards = self.cluster.collect_traces()
+            except Exception:
+                shards = []
+        if shards:
+            export.dump_merged_chrome_trace(
+                path, shards, spans, tracer_=self.tracer
+            )
+        else:
+            export.dump_chrome_trace(path, spans, tracer_=self.tracer)
+        return {"path": path, "spans": len(spans), "shards": len(shards)}
 
     # ------------------------------------------------------------ codecs
 

@@ -40,13 +40,16 @@ from khipu_tpu.trie.mpt import EMPTY_TRIE_HASH
 
 
 # graceful-degradation gauges served by the khipu_metrics RPC
-# (jsonrpc/eth_service.py). Module-level dict (not on the committer):
-# committers are rebuilt every epoch and the metric must survive them.
-WINDOW_GAUGES = {
+# (jsonrpc/eth_service.py), registered as khipu_window_* in the unified
+# registry. Module-level (not on the committer): committers are rebuilt
+# every epoch and the metric must survive them.
+from khipu_tpu.observability.registry import REGISTRY
+
+WINDOW_GAUGES = REGISTRY.gauge_group("khipu_window", {
     # windows whose fused device dispatch failed at runtime and fell
     # back to the host hasher (docs/recovery.md graceful degradation)
     "fused_fallbacks": 0,
-}
+}, help="window-commit graceful-degradation state (ledger/window.py)")
 
 
 class _StagedReadThrough:

@@ -319,3 +319,28 @@ class CompileEventLog:
 
 # THE process compile log (trie/fused.py writes, export.py reads)
 compile_log = CompileEventLog()
+
+
+# ------------------------------------------- phase-latency histograms
+#
+# The recorder feeds the unified registry: one Prometheus histogram
+# family (khipu_phase_latency_seconds{phase=...}) covering the
+# canonical lifecycle phases. Installed as the tracer's phase observer
+# so every recorded span of a canonical phase lands one ``observe`` —
+# scrapers get cumulative latency distributions without holding a span
+# ring snapshot.
+try:
+    from khipu_tpu.observability import trace as _trace
+    from khipu_tpu.observability.registry import REGISTRY as _REGISTRY
+
+    PHASE_HISTOGRAMS = {
+        p: _REGISTRY.histogram(
+            "khipu_phase_latency_seconds",
+            help="wall seconds per canonical lifecycle phase",
+            labels={"phase": p},
+        )
+        for p in LIFECYCLE_PHASES + (PHASE_STALL,)
+    }
+    _trace.set_phase_observer(PHASE_HISTOGRAMS)
+except Exception:  # pragma: no cover - stdlib-only deps
+    PHASE_HISTOGRAMS = {}

@@ -35,9 +35,11 @@ them as immutable once ``t1`` is set.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 __all__ = [
@@ -47,6 +49,8 @@ __all__ = [
     "span",
     "event",
     "current_token",
+    "current_tracer",
+    "use_tracer",
     "enable",
     "disable",
 ]
@@ -166,6 +170,10 @@ class Tracer:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.enabled = False
         self.capacity = capacity
+        # process/ring identity for cross-process propagation: rides the
+        # bridge as ``khipu-trace-id`` so a shard can link its server
+        # spans back to the driver ring that issued the RPC
+        self.trace_id = os.urandom(8).hex()
         self._buf: deque = deque(maxlen=capacity)
         self._ids = itertools.count(1)
         self._seq = itertools.count(1)  # appended-record counter
@@ -188,12 +196,16 @@ class Tracer:
         self.epoch_perf = time.perf_counter()
         self.epoch_wall = time.time()
         self.enabled = True
+        _ensure_phase_observer()
 
     def disable(self) -> None:
         self.enabled = False
 
     def reset(self) -> None:
-        """Drop every record and the drop counter; keep enabled state."""
+        """Drop every record and the drop counter; keep enabled state.
+        A new ring gets a new trace id — remote spans linked to the old
+        ring's tokens must not alias into the new one."""
+        self.trace_id = os.urandom(8).hex()
         self._buf = deque(maxlen=self.capacity)
         self._seq = itertools.count(1)
         self._last_seq = 0
@@ -246,6 +258,14 @@ class Tracer:
         # GIL-atomic append; maxlen makes it drop-oldest
         self._buf.append(s)
         self._last_seq = next(self._seq)
+        obs = _PHASE_OBSERVER
+        if obs is not None and s.t1 > s.t0:
+            h = obs.get(s.name)
+            if h is not None:
+                # feed the registry's phase-latency histogram (installed
+                # by observability/recorder.py) — one dict lookup on the
+                # enabled path, nothing at all when tracing is off
+                h.observe(s.t1 - s.t0)
 
     @property
     def dropped(self) -> int:
@@ -257,42 +277,107 @@ class Tracer:
         return self._last_seq
 
     def snapshot(self) -> List[Span]:
-        """Consistent copy of the ring, oldest first. Lock-free writers
-        may mutate the deque mid-copy; retry until a clean pass."""
+        """Copy-consistent view of the ring, oldest first.
+
+        Writers are lock-free, so two distinct tears are possible and
+        both are handled: (a) the deque mutates MID-iteration — CPython
+        raises RuntimeError and we retry; (b) an append lands BETWEEN a
+        clean copy and the caller's read of ``recorded``/``dropped`` —
+        the ring cursor (``_last_seq``) is read before and after the
+        copy and the copy only counts when the fence did not move, so a
+        snapshot can never disagree with the cursor state it is paired
+        with. Under pathological write pressure degrade to the best
+        fenced attempt rather than spinning forever."""
+        copy: List[Span] = []
         for _ in range(64):
+            fence = self._last_seq
             try:
-                return list(self._buf)
+                copy = list(self._buf)
             except RuntimeError:  # deque mutated during iteration
                 continue
-        # pathological write pressure: degrade to an approximate copy
-        return [s for s in tuple(self._buf)]
+            if self._last_seq == fence:
+                return copy
+        return copy if copy else [s for s in tuple(self._buf)]
 
     def to_wall(self, t_perf: float) -> float:
         """Map a perf_counter stamp to absolute unix seconds."""
         return self.epoch_wall + (t_perf - self.epoch_perf)
 
 
-# THE process tracer: hot paths import the module functions below,
-# which bind to this instance (tests may swap in their own Tracer via
-# ``tracer.enable(...)`` / ``reset`` — the instance itself is stable).
+# phase-name -> registry Histogram, installed by observability/recorder
+# (set_phase_observer) the first time a tracer is enabled. ``None``
+# until then — _record pays nothing extra before that.
+_PHASE_OBSERVER: Optional[Dict] = None
+
+
+def set_phase_observer(mapping: Optional[Dict]) -> None:
+    global _PHASE_OBSERVER
+    _PHASE_OBSERVER = mapping
+
+
+def _ensure_phase_observer() -> None:
+    """Importing the recorder installs the phase-latency histograms;
+    deferred to first enable so the disabled path never imports it."""
+    if _PHASE_OBSERVER is None:
+        try:
+            import khipu_tpu.observability.recorder  # noqa: F401
+        except Exception:
+            pass
+
+
+# THE process tracer — the DEFAULT instance. Hot paths import the
+# module functions below, which bind to the thread's CURRENT tracer
+# (``use_tracer``) and fall back to this one; drivers/services that own
+# a private ring (ReplayDriver, ServiceBoard, BridgeServer) activate it
+# for the extent of their work so module-level instrumentation seams
+# (ledger/window.py, trie/fused.py, cluster/client.py) record into the
+# right ring without threading a tracer through every signature.
 tracer = Tracer()
+
+_current = threading.local()
+
+
+def current_tracer() -> Tracer:
+    """The tracer module-level seams record into ON THIS THREAD: the
+    innermost ``use_tracer`` activation, else the process default."""
+    t = getattr(_current, "tracer", None)
+    return t if t is not None else tracer
+
+
+@contextmanager
+def use_tracer(t: Tracer):
+    """Activate ``t`` as this thread's current tracer for the block.
+    Re-entrant (activations nest/restore); other threads see their own
+    activation or the default — a collector job must activate its
+    driver's tracer itself (the token rides the job closure, and so
+    does the tracer)."""
+    prev = getattr(_current, "tracer", None)
+    _current.tracer = t
+    try:
+        yield t
+    finally:
+        _current.tracer = prev
 
 
 def span(name: str, parent: Optional[int] = None, **tags):
     """``with span("window.seal", block=n) as s: ...`` — the module-
     level entry the instrumentation seams use. Disabled: returns the
-    shared inert singleton (no allocation)."""
-    if not tracer.enabled:
+    shared inert singleton (no allocation; one thread-local load + two
+    branches)."""
+    t = getattr(_current, "tracer", None)
+    if t is None:
+        t = tracer
+    if not t.enabled:
         return _NULL_SPAN
-    return Span(tracer, name, parent, tags)
+    return Span(t, name, parent, tags)
 
 
 def event(name: str, parent: Optional[int] = None, **tags) -> None:
-    tracer.event(name, parent, **tags)
+    current_tracer().event(name, parent, **tags)
 
 
 def current_token() -> Optional[int]:
-    return tracer.current_token()
+    return current_tracer().current_token()
 
 
 def enable(capacity: Optional[int] = None) -> None:
@@ -303,15 +388,17 @@ def disable() -> None:
     tracer.disable()
 
 
-def apply_config(cfg) -> None:
+def apply_config(cfg, tracer_: Optional[Tracer] = None) -> None:
     """Wire an ObservabilityConfig (config.py): enable/disable the
-    process tracer and size the fused compile cache. Idempotent — safe
-    to call from every driver/service constructor."""
+    given tracer (default: the process instance) and size the fused
+    compile cache. Idempotent — safe to call from every driver/service
+    constructor."""
     if cfg is None:
         return
-    if cfg.enabled and not tracer.enabled:
-        tracer.enable(cfg.ring_capacity)
-    elif not cfg.enabled and tracer.enabled:
+    t = tracer_ if tracer_ is not None else tracer
+    if cfg.enabled and not t.enabled:
+        t.enable(cfg.ring_capacity)
+    elif not cfg.enabled and t.enabled:
         # an explicit disabled config does NOT stomp a manual enable()
         # (bench --trace flips the tracer on over a default config)
         pass
@@ -321,3 +408,22 @@ def apply_config(cfg) -> None:
         compile_cache.set_capacity(cfg.compile_cache_capacity)
     except Exception:
         pass
+
+
+# ring health is telemetry too: recorded/dropped/enabled for the
+# DEFAULT instance, served by khipu_metrics_text
+try:
+    from khipu_tpu.observability.registry import REGISTRY as _REGISTRY
+
+    _REGISTRY.register_collector(
+        "tracer",
+        lambda: [
+            ("khipu_trace_spans_recorded_total", "counter", {},
+             tracer.recorded),
+            ("khipu_trace_spans_dropped_total", "counter", {},
+             tracer.dropped),
+            ("khipu_trace_enabled", "gauge", {}, int(tracer.enabled)),
+        ],
+    )
+except Exception:  # pragma: no cover - registry is stdlib-only
+    pass

@@ -46,6 +46,14 @@ class ServiceBoard:
                 )
         self.tx_pool = PendingTransactionsPool()
         self.ommers_pool = OmmersPool()
+        # board-owned flight recorder: every service this board starts
+        # (RPC, bridge) records into THIS ring, so two boards in one
+        # process (tests, embedded shards) keep disjoint traces. The
+        # module-global tracer stays the default for bare drivers.
+        from khipu_tpu.observability.trace import Tracer, apply_config
+
+        self.tracer = Tracer()
+        apply_config(config.observability, self.tracer)
         self.node_key = self._load_or_create_node_key()
         self._rpc_server = None
         self._bridge_server = None
@@ -93,7 +101,7 @@ class ServiceBoard:
 
         service = EthService(
             self.blockchain, self.config, self.tx_pool,
-            cluster=self._cluster,
+            cluster=self._cluster, tracer=self.tracer,
         )
         extra = ()
         keystore_dir = key_dir or (
@@ -121,7 +129,8 @@ class ServiceBoard:
         from khipu_tpu.bridge import BridgeServer
 
         self._bridge_server = BridgeServer(
-            self.blockchain, self.config, device_commit=device_commit
+            self.blockchain, self.config, device_commit=device_commit,
+            tracer=self.tracer,
         )
         return self._bridge_server.start(host, port)
 

@@ -73,6 +73,18 @@ class WindowJournal:
     def __init__(self, source):
         self.source = source
         self._lock = threading.Lock()
+        # registry pull collector, replace-by-key: the newest journal
+        # (tests build hundreds) owns the khipu_journal_depth sample
+        try:
+            from khipu_tpu.observability.registry import REGISTRY
+
+            REGISTRY.register_collector(
+                "journal",
+                lambda: [("khipu_journal_depth", "gauge", {},
+                          self.depth)],
+            )
+        except Exception:  # pragma: no cover
+            pass
 
     # ----------------------------------------------------------- pointers
 

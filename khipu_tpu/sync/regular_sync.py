@@ -48,7 +48,7 @@ from khipu_tpu.network.messages import (
 )
 from khipu_tpu.network.peer import Peer, PeerError, PeerManager
 from khipu_tpu.observability.trace import span
-from khipu_tpu.sync.replay import ReplayDriver
+from khipu_tpu.sync.replay import CollectorDied, ReplayDriver
 from khipu_tpu.trie.mpt import MPTNodeMissingException
 from khipu_tpu.validators.roots import ommers_hash, transactions_root
 
@@ -456,6 +456,16 @@ class RegularSyncService:
                 f"windowed import missing node {e.hash[:8].hex()}; "
                 "healing per block"
             )
+        except CollectorDied:
+            # with graceful degradation OFF the operator asked for
+            # fail-stop semantics: a dead collector means a torn window
+            # may be on disk, and the per-block healing path must NOT
+            # paper over it — surface the death so the round aborts and
+            # startup recovery (sync/journal.py) settles the intent
+            if not self.config.sync.degrade_on_collector_death:
+                raise
+            self.log("windowed import lost its collector; "
+                     "healing per block")
         except Exception as e:  # noqa: BLE001
             self.log(f"windowed import fell back: {e}")
         return self.blockchain.best_block_number - before

@@ -25,7 +25,15 @@ from khipu_tpu.domain.blockchain import Blockchain
 from khipu_tpu.domain.difficulty import calc_difficulty
 from khipu_tpu.domain.transaction import recover_senders
 from khipu_tpu.ledger.ledger import execute_block
-from khipu_tpu.observability.trace import apply_config, event, span
+from khipu_tpu.observability.registry import REGISTRY
+from khipu_tpu.observability.trace import (
+    Tracer,
+    apply_config,
+    event,
+    span,
+    use_tracer,
+)
+from khipu_tpu.observability.trace import tracer as _default_tracer
 from khipu_tpu.validators.validators import (
     BlockHeaderValidator,
     BlockValidator,
@@ -33,9 +41,11 @@ from khipu_tpu.validators.validators import (
 )
 
 # live window-pipeline gauges served by the khipu_metrics RPC
-# (jsonrpc/eth_service.py). Plain-dict writes are GIL-atomic; the
-# collector thread and the driver both update them in place.
-PIPELINE_GAUGES = {
+# (jsonrpc/eth_service.py), registered as khipu_pipeline_* in the
+# unified registry. The GaugeGroup keeps dict-style writes — a gauge
+# set is one attribute store, so the collector thread and the driver
+# both update them in place exactly as the plain dict allowed.
+PIPELINE_GAUGES = REGISTRY.gauge_group("khipu_pipeline", {
     "depth": 0,  # configured pipeline_depth of the last run
     "in_flight": 0,  # windows sealed but not yet collected
     "windows_sealed": 0,
@@ -46,7 +56,7 @@ PIPELINE_GAUGES = {
     "collector_deaths": 0,  # dead workers detected by liveness checks
     "sync_fallback_windows": 0,  # windows committed synchronously after
     # a collector death (graceful degradation — docs/recovery.md)
-}
+}, help="window-pipeline state (sync/replay.py)")
 
 
 class CollectorDied(RuntimeError):
@@ -280,10 +290,16 @@ class ReplayDriver:
         log: Optional[Callable[[str], None]] = None,
         validate_headers: bool = True,
         device_commit: bool = False,
+        tracer: Optional[Tracer] = None,
     ):
         self.blockchain = blockchain
         self.config = config
-        apply_config(config.observability)
+        # per-driver recorder: a driver handed its own Tracer (e.g. the
+        # bridge server's — bridge.py) records there; the default stays
+        # the module-global instance so single-driver processes and the
+        # existing khipu_traces surface are unchanged
+        self.tracer = tracer if tracer is not None else _default_tracer
+        apply_config(config.observability, self.tracer)
         apply_fault_config(getattr(config, "faults", None))
         self.log = log
         self.header_validator = BlockHeaderValidator(
@@ -321,12 +337,24 @@ class ReplayDriver:
             return self.replay_windowed(blocks, window)
         stats = ReplayStats()
         t_start = time.perf_counter()
-        for block in blocks:
-            self._execute_and_insert(block, stats)
+        with use_tracer(self.tracer):
+            for block in blocks:
+                self._execute_and_insert(block, stats)
         stats.seconds = time.perf_counter() - t_start
         return stats
 
     def replay_windowed(
+        self, blocks: Iterable[Block], window_size: int
+    ) -> ReplayStats:
+        """Window-batched PIPELINED replay: runs with THIS driver's
+        tracer active on the calling thread (collector jobs re-activate
+        it on theirs — the tracer rides the closure like ``seal_tok``),
+        so concurrent drivers in one process record to disjoint rings.
+        See ``_replay_windowed`` for the pipeline itself."""
+        with use_tracer(self.tracer):
+            return self._replay_windowed(blocks, window_size)
+
+    def _replay_windowed(
         self, blocks: Iterable[Block], window_size: int
     ) -> ReplayStats:
         """Window-batched PIPELINED replay: execute W blocks against one
@@ -470,8 +498,17 @@ class ReplayDriver:
             # seal that produced them (the cross-thread parent edge —
             # flow arrows in the Chrome dump)
             lo, hi = results[0][0].number, results[-1][0].number
+            tr = self.tracer
 
             def run():
+                # the driver's tracer rides the closure: the collector
+                # thread has no thread-local binding of its own, and
+                # falling back to the module default would split one
+                # driver's trace across two rings
+                with use_tracer(tr):
+                    _run()
+
+            def _run():
                 # chaos seams: a rule at any of the collector.* sites
                 # models a failure/death at that phase of the job
                 # (docs/recovery.md crash-point table)

@@ -698,3 +698,150 @@ class TestDeterminism:
         assert snap["bySite"]["m.one"] == 3
         assert len(fault_log.recent()) == 3
         fault_log.reset()
+
+
+# ------------------------- die inside the storage layer (save_block)
+
+
+class TestStorageLayerDeath:
+    def test_die_mid_save_block_torn_record_recovers(self, chain):
+        """Death INSIDE save_block, between two block-store puts: the
+        header of block 1 lands, its body never does — a torn RECORD,
+        one level below the torn-window case. Startup recovery must
+        treat the half-written block as part of the torn window and
+        roll it back; the resumed replay is bit-exact."""
+        cfg = _cfg(window=2, depth=2, degrade=False)
+        bc = _fresh(cfg)
+        # save_block's put order is header, body, receipts, td
+        # (domain/blockchain.py); after=1 dies on the BODY put of the
+        # first saved block — the header write already committed
+        plan = FaultPlan(
+            seed=11,
+            rules=[FaultRule("storage.block.put", "die", after=1,
+                             times=1)],
+        )
+        with active(plan):
+            with pytest.raises(CollectorDied):
+                ReplayDriver(bc, cfg).replay(chain)
+        assert [s for (s, _, _, _) in plan.fired] == [
+            "storage.block.put"
+        ]
+        # the torn record IS visible pre-recovery: header without body,
+        # best never advanced (app_state moves only after a full save)
+        assert bc.storages.app_state.best_block_number == 0
+        assert bc.get_header_by_number(1) is not None
+        assert bc.storages.block_body_storage.get(1) is None
+        assert bc.storages.window_journal.pending()
+
+        report = ReplayDriver(bc, cfg).recover()
+        assert report.rolled_back >= 1
+        assert report.best_after == 0
+        assert bc.get_header_by_number(1) is None  # torn record undone
+        assert bc.storages.window_journal.pending() == []
+
+        ReplayDriver(bc, _cfg(window=1, depth=1)).replay(chain)
+        _assert_same_chain(bc, _clean_reference(chain))
+
+
+# --------------------- die in the collector during a regular_sync round
+
+
+class TestRegularSyncCollectorDeath:
+    """The windowed import path of a LIVE sync round (not a bare
+    replay): the collector dies mid regular_sync import, the round
+    fails locally without demoting the peer or killing the loop, and a
+    restart-style recovery + resumed sync lands bit-exact."""
+
+    @staticmethod
+    def _loopback(server_bc, syncer_bc):
+        from khipu_tpu.network.host_service import HostService
+        from khipu_tpu.network.messages import Status
+        from khipu_tpu.network.peer import PeerManager
+
+        priv_a = (0xA11CE).to_bytes(32, "big")
+        priv_b = (0xB0B).to_bytes(32, "big")
+
+        def status_of(bc):
+            def make():
+                best = bc.best_block_number
+                return Status(
+                    protocol_version=63,
+                    network_id=1,
+                    total_difficulty=(
+                        bc.get_total_difficulty(best) or 0
+                    ),
+                    best_hash=bc.get_hash_by_number(best),
+                    genesis_hash=bc.get_hash_by_number(0),
+                )
+            return make
+
+        server = PeerManager(
+            priv_a, "khipu-tpu/server", status_of(server_bc)
+        )
+        HostService(server_bc).install(server)
+        port = server.listen()
+        client = PeerManager(
+            priv_b, "khipu-tpu/client", status_of(syncer_bc)
+        )
+        client.connect(
+            "127.0.0.1", port, privkey_to_pubkey(priv_a)
+        )
+        return server, client
+
+    def test_collector_dies_mid_sync_round_then_recovery(self, chain):
+        from khipu_tpu.sync.regular_sync import RegularSyncService
+
+        serve_cfg = _cfg(window=1, depth=1)
+        server_bc = _fresh(serve_cfg)
+        ReplayDriver(server_bc, serve_cfg).replay(chain)
+
+        cfg = _cfg(window=2, depth=2, degrade=False)
+        syncer_bc = _fresh(cfg)
+        server, client = self._loopback(server_bc, syncer_bc)
+        try:
+            sync = RegularSyncService(
+                syncer_bc, cfg, client, batch_size=N_BLOCKS
+            )
+            # die right after the collector saves block 1: window [1,2]
+            # is torn (1 on disk, 2 and the commit mark missing)
+            plan = FaultPlan(
+                seed=7,
+                rules=[FaultRule("collector.save", "die", after=0,
+                                 times=1)],
+            )
+            with active(plan):
+                imported = sync.sync_once()
+            # fail-stop semantics surface as a LOCAL round failure: the
+            # loop survives, the peer is NOT blamed, nothing imported
+            assert imported == 0
+            assert [s for (s, _, _, _) in plan.fired] == [
+                "collector.save"
+            ]
+            assert not client.blacklist.is_blacklisted(
+                privkey_to_pubkey((0xA11CE).to_bytes(32, "big"))
+            )
+            # the torn window is on disk awaiting startup recovery
+            assert syncer_bc.storages.app_state.best_block_number == 1
+            assert syncer_bc.get_header_by_number(2) is None
+            assert syncer_bc.storages.window_journal.pending()
+
+            # "restart": recovery pass over the same storages, then a
+            # fresh sync service (new driver, fresh collector)
+            report = ReplayDriver(syncer_bc, cfg).recover()
+            assert report.rolled_back >= 1
+            assert report.best_after == 0
+            assert syncer_bc.storages.window_journal.pending() == []
+
+            resumed = RegularSyncService(
+                syncer_bc, cfg, client, batch_size=N_BLOCKS
+            )
+            resumed.run(
+                until=lambda: (
+                    syncer_bc.best_block_number >= N_BLOCKS
+                ),
+                max_seconds=60,
+            )
+            _assert_same_chain(syncer_bc, _clean_reference(chain))
+        finally:
+            client.stop()
+            server.stop()

@@ -329,7 +329,8 @@ class TestExport:
         from khipu_tpu.jsonrpc.eth_service import EthService
 
         for name in ("khipu_traces", "khipu_trace_block",
-                     "khipu_dump_chrome_trace"):
+                     "khipu_dump_chrome_trace", "khipu_metrics",
+                     "khipu_metrics_text"):
             assert callable(getattr(EthService, name))
 
 
@@ -434,3 +435,286 @@ class TestBenchTrace:
                 "breakdown disagreed with wall clock on 3/3 runs: "
                 f"{report}"
             )
+
+
+# ------------------------------------------------- unified registry
+
+
+class TestRegistry:
+    """khipu_tpu/observability/registry.py: the typed instrument set +
+    pull collectors every legacy counter dict migrated onto."""
+
+    def test_counter_gauge_histogram(self):
+        from khipu_tpu.observability.registry import MetricsRegistry
+
+        r = MetricsRegistry()
+        c = r.counter("reqs_total", help="requests")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        g = r.gauge("depth")
+        g.set(7)
+        g.inc()
+        g.dec(3)
+        assert g.value == 5
+        h = r.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.05, 5.0):
+            h.observe(v)
+        hv = h.value
+        assert hv["count"] == 4
+        assert abs(hv["sum"] - 5.105) < 1e-9
+        # cumulative le semantics: 1 <=0.01, 3 <=0.1, 3 <=1.0 (+Inf=4)
+        assert hv["buckets"] == {0.01: 1, 0.1: 3, 1.0: 3}
+
+    def test_idempotent_reregister_and_kind_conflict(self):
+        from khipu_tpu.observability.registry import MetricsRegistry
+
+        r = MetricsRegistry()
+        a = r.counter("x_total")
+        assert r.counter("x_total") is a  # same (name, labels) -> same
+        with pytest.raises(ValueError):
+            r.gauge("x_total")  # kind flip is a bug, loudly
+        # distinct labels are distinct instruments of one family
+        ep1 = r.counter("y_total", labels={"endpoint": "a"})
+        ep2 = r.counter("y_total", labels={"endpoint": "b"})
+        assert ep1 is not ep2
+        ep1.inc(2)
+        snap = r.snapshot()
+        assert snap["y_total"] == {'endpoint="a"': 2, 'endpoint="b"': 0}
+
+    def test_gauge_group_shim_keeps_dict_call_sites(self):
+        from khipu_tpu.observability.registry import MetricsRegistry
+
+        r = MetricsRegistry()
+        gg = r.gauge_group("khipu_pipe", {"in_flight": 0, "depth": 2})
+        # the verbatim legacy write patterns
+        gg["in_flight"] += 1
+        gg["in_flight"] += 1
+        gg["depth"] = 4
+        assert gg["in_flight"] == 2
+        assert "depth" in gg and len(gg) == 2
+        assert dict(gg.items())["depth"] == 4
+        # the values LIVE in the registry, served by name
+        snap = r.snapshot()
+        assert snap["khipu_pipe_in_flight"] == 2
+        assert snap["khipu_pipe_depth"] == 4
+        gg.reset()
+        assert r.snapshot()["khipu_pipe_depth"] == 2
+
+    def test_collector_replace_by_key_and_failure_dropped(self):
+        from khipu_tpu.observability.registry import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.register_collector(
+            "j", lambda: [("d", "gauge", {}, 1)]
+        )
+        r.register_collector(
+            "j", lambda: [("d", "gauge", {}, 9)]
+        )  # newest owner of the state wins — no dead-entry leak
+        def boom():
+            raise RuntimeError("broken source")
+        r.register_collector("bad", boom)
+        snap = r.snapshot()
+        assert snap["d"] == 9  # replaced, not duplicated
+        assert "bad" not in snap  # failure dropped, scrape survived
+        r.unregister_collector("j")
+        assert "d" not in r.snapshot()
+
+    def test_prometheus_text_exposition(self):
+        from khipu_tpu.observability.registry import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.counter("c_total", help="a counter").inc(3)
+        r.gauge("g", labels={"shard": "a"}).set(1)
+        r.gauge("g", labels={"shard": "b"}).set(2)
+        h = r.histogram("h_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        text = r.prometheus_text()
+        lines = text.splitlines()
+        assert "# HELP c_total a counter" in lines
+        assert "# TYPE c_total counter" in lines
+        assert "c_total 3" in lines
+        assert 'g{shard="a"} 1' in lines and 'g{shard="b"} 2' in lines
+        assert lines.count("# TYPE g gauge") == 1  # ONE family header
+        assert 'h_seconds_bucket{le="0.1"} 1' in lines
+        assert 'h_seconds_bucket{le="1.0"} 2' in lines
+        assert 'h_seconds_bucket{le="+Inf"} 2' in lines
+        assert "h_seconds_count 2" in lines
+        assert any(ln.startswith("h_seconds_sum ") for ln in lines)
+
+    def test_process_registry_serves_migrated_families(self):
+        """The legacy dicts (PIPELINE_GAUGES, WINDOW_GAUGES, chaos
+        fault log, tracer ring health) all surface as families of THE
+        process registry."""
+        from khipu_tpu.observability.registry import REGISTRY
+        import khipu_tpu.chaos.plan  # noqa: F401 - registers collector
+        import khipu_tpu.ledger.window  # noqa: F401
+        import khipu_tpu.sync.replay  # noqa: F401
+
+        snap = REGISTRY.snapshot()
+        for family in (
+            "khipu_pipeline_depth",
+            "khipu_pipeline_in_flight",
+            "khipu_pipeline_windows_sealed",
+            "khipu_window_fused_fallbacks",
+            "khipu_chaos_faults_fired_total",
+            "khipu_trace_spans_recorded_total",
+            "khipu_trace_enabled",
+        ):
+            assert family in snap, family
+
+
+# --------------------------------------------- snapshot fence (bugfix)
+
+
+class TestSnapshotFence:
+    def test_two_thread_snapshot_stress(self):
+        """The copy-consistency fix: a reader snapshotting while a
+        writer floods the ring must never raise (deque mutation mid-
+        iteration) and every snapshot must be internally ordered —
+        oldest first, tags monotonic — even across drop-oldest
+        overflow."""
+        t = Tracer(capacity=256)
+        t.enable()
+        stop = threading.Event()
+        writer_err = []
+
+        def writer():
+            i = 0
+            try:
+                while not stop.is_set():
+                    t.event("stress", i=i)
+                    i += 1
+            except Exception as e:  # pragma: no cover - the regression
+                writer_err.append(e)
+
+        th = threading.Thread(target=writer, name="stress-writer")
+        th.start()
+        try:
+            snapshots = 0
+            for _ in range(400):
+                snap = t.snapshot()
+                assert len(snap) <= t.capacity
+                seq = [s.tags["i"] for s in snap if s.name == "stress"]
+                # a torn copy would interleave out of order or dup
+                assert seq == sorted(seq)
+                assert len(set(seq)) == len(seq)
+                snapshots += 1
+        finally:
+            stop.set()
+            th.join(timeout=10)
+        assert not writer_err
+        assert snapshots == 400
+        assert t.dropped > 0  # the stress actually wrapped the ring
+
+
+# ----------------------------------- metrics superset + text agreement
+
+
+class TestMetricsSuperset:
+    @pytest.fixture(scope="class")
+    def svc(self, chain):
+        """EthService over a freshly replayed pipelined chain."""
+        from khipu_tpu.jsonrpc.eth_service import EthService
+        from khipu_tpu.txpool import PendingTransactionsPool
+
+        cfg = pipeline_cfg(w=2, depth=2)
+        bc = _fresh_chain(cfg)
+        ReplayDriver(bc, cfg).replay(chain)
+        return EthService(bc, cfg, PendingTransactionsPool())
+
+    def test_khipu_metrics_is_key_compatible_superset(self, svc):
+        """Every pre-registry key survives unchanged; the registry
+        snapshot rides along as a new section and AGREES with the
+        legacy values it mirrors."""
+        out = svc.khipu_metrics()
+        # legacy surface, verbatim
+        assert out["bestBlockNumber"] == N_BLOCKS
+        assert {"account", "storage", "evmcode"} <= set(out["stores"])
+        for legacy in ("cacheHitRate", "cacheReadCount"):
+            assert legacy in out["stores"]["account"]
+        assert {
+            "depth", "inFlight", "windowsSealed", "windowsCollected",
+            "occupancy", "driverStallSeconds", "collectorBusySeconds",
+            "collectorDeaths", "syncFallbackWindows",
+        } <= set(out["pipeline"])
+        assert {"fusedFallbacks", "journalDepth", "faults"} <= set(
+            out["robustness"]
+        )
+        # the superset sections
+        reg = out["registry"]
+        assert reg["khipu_pipeline_windows_sealed"] == (
+            out["pipeline"]["windowsSealed"]
+        )
+        assert reg["khipu_pipeline_depth"] == out["pipeline"]["depth"]
+        assert reg["khipu_window_fused_fallbacks"] == (
+            out["robustness"]["fusedFallbacks"]
+        )
+        assert reg["khipu_best_block_number"] == N_BLOCKS
+        assert "phaseLatency" in out
+        json.dumps(out)  # the whole document stays JSON-serializable
+
+    def test_metrics_text_agrees_with_snapshot(self, svc):
+        """khipu_metrics_text serves the SAME values the structured
+        snapshot carries — one source of truth, two encodings."""
+        out = svc.khipu_metrics()
+        text = svc.khipu_metrics_text()
+        lines = text.splitlines()
+        assert f"khipu_best_block_number {N_BLOCKS}" in lines
+        sealed = out["pipeline"]["windowsSealed"]
+        assert f"khipu_pipeline_windows_sealed {sealed}" in lines
+        pending = out["pendingTxs"]
+        assert f"khipu_pending_txs {pending}" in lines
+
+
+# --------------------------------------- bench --trace registry smoke
+
+
+class TestBenchTraceRegistrySmoke:
+    def test_trace_smoke_chrome_valid_and_families_unique(self, tmp_path):
+        """CI satellite: the bench --trace path end to end — the chrome
+        trace it writes is valid JSON with events, and EVERY family in
+        the registry snapshot appears exactly once (one # TYPE line,
+        >=1 sample line) in the khipu_metrics_text exposition."""
+        import re
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        from bench import run_traced_replay
+
+        from khipu_tpu.observability.registry import REGISTRY
+
+        chrome = tmp_path / "bench_trace.json"
+        stats, report = run_traced_replay(
+            n_blocks=12, txs_per_block=4, window=2, pipeline_depth=2,
+            device_commit=False, chrome_out=str(chrome),
+        )
+        assert stats.blocks == 12
+        assert report["chrome_trace"] == str(chrome)
+        doc = json.loads(chrome.read_text())
+        assert doc["traceEvents"]
+        assert report["registry_families"] > 0
+        # phase histograms observed real latencies during the run
+        assert report["phase_observations"]
+        assert sum(report["phase_observations"].values()) > 0
+
+        snap = REGISTRY.snapshot()
+        text = REGISTRY.prometheus_text()
+        lines = text.splitlines()
+        type_lines = [ln for ln in lines if ln.startswith("# TYPE ")]
+        # families and TYPE headers are in bijection
+        assert len(type_lines) == len(snap)
+        for name in snap:
+            headers = [
+                ln for ln in type_lines
+                if ln.startswith(f"# TYPE {name} ")
+            ]
+            assert len(headers) == 1, name
+            pat = re.compile(
+                rf"^{re.escape(name)}(_bucket|_sum|_count)?(\{{| )"
+            )
+            assert any(
+                pat.match(ln) for ln in lines if not ln.startswith("#")
+            ), name

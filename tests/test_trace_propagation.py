@@ -1,0 +1,445 @@
+"""Cluster-wide distributed tracing (the PR-5 tentpole): Dapper-style
+metadata propagation over the gRPC bridge, the GetTraceSpans span-ring
+pull, the Ping clock-probe offset estimate, and the merged chrome trace
+that nests shard-side server spans inside the exact driver RPC spans
+that caused them — offset-corrected, non-negative nesting.
+
+Also the per-driver tracer rings: two drivers (or two bridge servers)
+in one process record into disjoint rings (the ROADMAP isolation note).
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.config import SyncConfig, fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.observability import export
+from khipu_tpu.observability.trace import (
+    Tracer,
+    current_tracer,
+    tracer,
+    use_tracer,
+)
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.sync.replay import ReplayDriver
+
+grpc = pytest.importorskip("grpc")
+
+from khipu_tpu.bridge import (  # noqa: E402
+    CLOCK_PROBE,
+    MD_PARENT_TOKEN,
+    MD_SAMPLED,
+    MD_TRACE_ID,
+    BridgeClient,
+    BridgeServer,
+    _encode_trace_spans,
+    decode_trace_spans,
+)
+
+CFG = fixture_config(chain_id=1)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(3)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ALLOC = {a: 10**21 for a in ADDRS}
+
+
+def build_blocks(n=4):
+    builder = ChainBuilder(
+        Blockchain(Storages(), CFG), CFG, GenesisSpec(alloc=ALLOC)
+    )
+    return [
+        builder.add_block(
+            [sign_transaction(
+                Transaction(i, 10**9, 21000, ADDRS[1], 5), KEYS[0],
+                chain_id=1,
+            )],
+            coinbase=b"\xaa" * 20,
+        )
+        for i in range(n)
+    ]
+
+
+def _start_shard():
+    bc = Blockchain(Storages(), CFG)
+    bc.load_genesis(GenesisSpec(alloc=ALLOC))
+    server = BridgeServer(bc, CFG)
+    port = server.start(port=0)
+    server.tracer.enable()
+    return server, BridgeClient(f"127.0.0.1:{port}", deadline=10.0)
+
+
+@pytest.fixture()
+def shard():
+    server, client = _start_shard()
+    yield server, client
+    client.close()
+    server.stop()
+
+
+@pytest.fixture()
+def driver_tracing():
+    """Module tracer enabled with a fresh ring for the driver side."""
+    tracer.enable()
+    tracer.reset()
+    yield tracer
+    tracer.disable()
+    tracer.reset()
+
+
+# --------------------------------------------------------- propagation
+
+
+class TestPropagation:
+    def test_server_span_links_remote_parent(self, shard, driver_tracing):
+        """The client's bridge.call span token + trace id arrive as
+        metadata; the server records them as remote_* tags on its
+        bridge.serve span — the cross-process edge the merge resolves."""
+        server, client = shard
+        with tracer.span("driver.work"):
+            client.best_block()
+        calls = [s for s in tracer.snapshot() if s.name == "bridge.call"]
+        assert len(calls) == 1
+        assert calls[0].tags["method"] == "BestBlock"
+        serves = [
+            s for s in server.tracer.snapshot()
+            if s.name == "bridge.serve.BestBlock"
+        ]
+        assert len(serves) == 1
+        tags = serves[0].tags
+        assert tags["remote_trace"] == tracer.trace_id
+        assert tags["remote_parent"] == calls[0].sid
+
+    def test_unsampled_call_carries_no_remote_tags(self, shard):
+        """Tracing off on the caller: the metadata keys still ship
+        (khipu-sampled=0) but the server must NOT record a remote
+        linkage into a trace id that never recorded the client half."""
+        server, client = shard
+        assert not tracer.enabled
+        client.best_block()
+        serves = [
+            s for s in server.tracer.snapshot()
+            if s.name == "bridge.serve.BestBlock"
+        ]
+        assert len(serves) == 1
+        assert "remote_trace" not in serves[0].tags
+        assert "remote_parent" not in serves[0].tags
+
+    def test_metadata_keys_are_unconditional(self, shard):
+        """Wire contract: all three keys ride EVERY call — sampled
+        flips with tracer state, the ids stay greppable either way."""
+        _, client = shard
+        captured = []
+        real = client.channel.unary_unary
+
+        def wrap(path, request_serializer=None,
+                 response_deserializer=None):
+            fn = real(path, request_serializer=request_serializer,
+                      response_deserializer=response_deserializer)
+
+            def call(payload, timeout=None, metadata=None):
+                captured.append(dict(metadata or ()))
+                return fn(payload, timeout=timeout, metadata=metadata)
+
+            return call
+
+        client.channel.unary_unary = wrap
+        client.ping(b"x")  # tracing off
+        tracer.enable()
+        live_trace_id = tracer.trace_id
+        try:
+            client.ping(b"y")
+        finally:
+            tracer.disable()
+            tracer.reset()
+        off, on = captured
+        for md in (off, on):
+            assert {MD_TRACE_ID, MD_PARENT_TOKEN, MD_SAMPLED} <= set(md)
+        assert off[MD_SAMPLED] == "0"
+        assert off[MD_PARENT_TOKEN] == ""  # no live span when off
+        assert on[MD_SAMPLED] == "1"
+        assert on[MD_TRACE_ID] == live_trace_id
+        assert on[MD_PARENT_TOKEN].isdigit()  # the bridge.call token
+
+
+# -------------------------------------------------------- span-ring RPC
+
+
+class TestGetTraceSpans:
+    def test_roundtrip_preserves_fields(self):
+        t = Tracer(capacity=64)
+        t.enable()
+        with t.span("outer", block=7, root=b"\xab\xcd"):
+            with t.span("inner"):
+                pass
+        t.event("blip", kind="x")
+        decoded = decode_trace_spans(_encode_trace_spans(t))
+        assert decoded["traceId"] == t.trace_id
+        spans = {s["name"]: s for s in decoded["spans"]}
+        assert set(spans) == {"outer", "inner", "blip"}
+        assert spans["outer"]["tags"] == {"block": 7, "root": "abcd"}
+        assert spans["inner"]["parent"] == spans["outer"]["sid"]
+        assert spans["outer"]["t0_wall"] <= spans["inner"]["t0_wall"]
+        assert spans["inner"]["t1_wall"] <= spans["outer"]["t1_wall"]
+        for s in decoded["spans"]:
+            assert s["t1_wall"] >= s["t0_wall"]
+            assert not s["error"]
+            assert s["thread_name"]
+
+    def test_rpc_pull_matches_server_ring(self, shard, driver_tracing):
+        server, client = shard
+        client.best_block()
+        client.ping(b"ok")
+        data = client.get_trace_spans()
+        assert data["traceId"] == server.tracer.trace_id
+        names = [s["name"] for s in data["spans"]]
+        assert "bridge.serve.BestBlock" in names
+        assert "bridge.serve.Ping" in names
+
+    def test_plain_ping_still_echoes(self, shard):
+        _, client = shard
+        assert client.ping(b"khipu") == b"khipu"
+        assert client.ping(b"hb") == b"hb"
+        assert CLOCK_PROBE != b"khipu"
+
+
+# --------------------------------------------------------- clock probe
+
+
+class TestClockProbe:
+    def test_injected_offset_recovered_within_rtt_bound(self, shard):
+        """Satellite gate: shift the shard's wall anchor by a known
+        3.5s — probe answers AND span encodings shift together (exactly
+        a skewed host clock) — and the NTP-style estimate must land
+        within the RTT/2 error bound. A small additive floor covers the
+        sub-ms skew between the server's epoch_wall/epoch_perf sampling
+        instants (a fixed anchoring cost, not an estimator error)."""
+        server, client = shard
+        skew = 3.5
+        server.tracer.epoch_wall += skew
+        offset, rtt = client.clock_probe(samples=7)
+        assert rtt >= 0
+        assert abs(offset - skew) <= rtt / 2 + 0.005, (offset, rtt)
+
+    def test_zero_offset_loopback(self, shard):
+        """Unskewed loopback: the estimate itself must be near zero."""
+        _, client = shard
+        offset, rtt = client.clock_probe(samples=7)
+        assert abs(offset) <= rtt / 2 + 0.005
+
+    def test_shard_timeline_descriptor(self, shard, driver_tracing):
+        server, client = shard
+        client.best_block()
+        sh = export.shard_timeline(client, endpoint="ep-1")
+        assert sh["endpoint"] == "ep-1"
+        assert sh["traceId"] == server.tracer.trace_id
+        assert any(
+            s["name"] == "bridge.serve.BestBlock" for s in sh["spans"]
+        )
+        assert sh["rtt_s"] >= 0
+
+
+# ------------------------------------------------------- merged trace
+
+
+def _nesting_check(doc, driver_spans):
+    """Every shard event whose remote parent resolves in the driver
+    ring must render INSIDE that driver span's interval (non-negative
+    nesting after offset correction — the acceptance gate)."""
+    by_id = {s.sid: s for s in driver_spans}
+    checked = 0
+    for e in doc["traceEvents"]:
+        if e.get("pid", 1) < 2 or e["ph"] not in ("X", "i"):
+            continue
+        args = e.get("args", {})
+        rp = args.get("remote_parent")
+        if rp is None or args.get("remote_trace") != tracer.trace_id:
+            continue
+        parent = by_id.get(rp)
+        if parent is None:
+            continue
+        p0 = (parent.t0 - tracer.epoch_perf) * 1e6
+        p1 = (parent.t1 - tracer.epoch_perf) * 1e6
+        ts = e["ts"]
+        dur = e.get("dur", 0.0)
+        assert ts >= p0 - 1e-2, (e["name"], ts, p0)
+        assert ts + dur <= p1 + 1e-2, (e["name"], ts + dur, p1)
+        checked += 1
+    return checked
+
+
+class TestMergedTrace:
+    def test_two_shard_replay_one_nested_trace(self, driver_tracing,
+                                               tmp_path):
+        """THE acceptance scenario: a driver executes blocks on two
+        traced shards; the merged chrome trace is ONE document where
+        every resolved shard server span nests inside its driver RPC
+        span with offset-corrected timestamps, each shard under its own
+        pid, with cross-process rpc flow arrows."""
+        blocks = build_blocks(4)
+        s1, c1 = _start_shard()
+        s2, c2 = _start_shard()
+        # distinct injected skews: the merge must correct each shard
+        # with ITS OWN offset estimate
+        s1.tracer.epoch_wall += 2.0
+        s2.tracer.epoch_wall -= 1.5
+        try:
+            with tracer.span("driver.batch", blocks=len(blocks)):
+                c1.execute_blocks(blocks[:2])
+                c2.execute_blocks(blocks)
+                c1.execute_blocks(blocks[2:])
+            driver_spans = tracer.snapshot()
+            shards = [
+                export.shard_timeline(c1, endpoint="shard-a"),
+                export.shard_timeline(c2, endpoint="shard-b"),
+            ]
+            path = tmp_path / "merged.json"
+            export.dump_merged_chrome_trace(
+                str(path), shards, driver_spans
+            )
+            doc = json.loads(path.read_text())  # valid JSON end to end
+
+            meta = doc["otherData"]["shards"]
+            assert [m["endpoint"] for m in meta] == ["shard-a", "shard-b"]
+            assert meta[0]["pid"] == 2 and meta[1]["pid"] == 3
+            assert abs(meta[0]["offsetSeconds"] - 2.0) < 0.1
+            assert abs(meta[1]["offsetSeconds"] + 1.5) < 0.1
+            # every ExecuteBlocks serve span resolved + nested
+            assert meta[0]["nestedUnderDriver"] >= 2
+            assert meta[1]["nestedUnderDriver"] >= 1
+            assert _nesting_check(doc, driver_spans) >= 3
+
+            # shard replay work (window spans) rides under the shard's
+            # own pid — the bridge driver ran with the SERVER's tracer
+            shard_names = {
+                e["name"] for e in doc["traceEvents"]
+                if e.get("pid") == 2 and e["ph"] in ("X", "i")
+            }
+            assert "bridge.serve.ExecuteBlocks" in shard_names
+            # cross-process rpc flow arrows come in s/f pairs that
+            # jump from pid 1 to the shard pid
+            starts = {
+                e["id"]: e for e in doc["traceEvents"]
+                if e["ph"] == "s" and e.get("cat") == "rpc"
+            }
+            finishes = [
+                e for e in doc["traceEvents"]
+                if e["ph"] == "f" and e.get("cat") == "rpc"
+            ]
+            assert finishes and starts
+            for f in finishes:
+                assert starts[f["id"]]["pid"] == 1
+                assert f["pid"] >= 2
+        finally:
+            c1.close(); c2.close()
+            s1.stop(); s2.stop()
+
+    def test_cluster_collect_traces_feeds_merge(self, driver_tracing):
+        """ShardedNodeClient.collect_traces pulls every live member's
+        timeline — the khipu_dump_chrome_trace cluster path."""
+        from khipu_tpu.cluster import ShardedNodeClient
+
+        s1, c1 = _start_shard()
+        s2, c2 = _start_shard()
+        try:
+            # endpoints are only used as factory keys here
+            eps = ["a", "b"]
+            chans = {"a": c1, "b": c2}
+            cl = ShardedNodeClient(
+                eps, replication=1, max_retries=0,
+                channel_factory=lambda ep: chans[ep],
+                sleep=lambda s: None,
+            )
+            c1.best_block()
+            shards = cl.collect_traces(probe_samples=2)
+            assert {sh["endpoint"] for sh in shards} == {"a", "b"}
+            for sh in shards:
+                assert "offset_s" in sh and "spans" in sh
+            doc = export.merged_chrome_trace(shards)
+            json.dumps(doc)
+            assert len(doc["otherData"]["shards"]) == 2
+        finally:
+            c1.close(); c2.close()
+            s1.stop(); s2.stop()
+
+
+# ------------------------------------------------- per-driver tracers
+
+
+class TestPerDriverTracers:
+    def test_driver_owned_ring_is_isolated(self):
+        """A ReplayDriver handed its own Tracer records there — the
+        module-global ring stays empty (the ROADMAP isolation note)."""
+        blocks = build_blocks(4)
+        cfg = dataclasses.replace(
+            CFG,
+            sync=SyncConfig(
+                parallel_tx=False, commit_window_blocks=2,
+                pipeline_depth=2,
+            ),
+        )
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc=ALLOC))
+        mine = Tracer(capacity=4096)
+        mine.enable()
+        assert not tracer.enabled
+        before = tracer.recorded
+        ReplayDriver(bc, cfg, tracer=mine).replay(blocks)
+        spans = mine.snapshot()
+        names = {s.name for s in spans}
+        # driver AND collector-thread spans landed in the private ring
+        assert {"window.build", "window.seal", "window.collect",
+                "window.persist"} <= names
+        assert tracer.recorded == before  # module ring untouched
+
+    def test_two_bridge_servers_disjoint_rings(self, driver_tracing):
+        """Two in-process shards never interleave span rings, and their
+        trace ids differ — GetTraceSpans pulls stay attributable."""
+        s1, c1 = _start_shard()
+        s2, c2 = _start_shard()
+        try:
+            assert s1.tracer is not s2.tracer
+            assert s1.tracer.trace_id != s2.tracer.trace_id
+            c1.best_block()
+            assert any(
+                s.name == "bridge.serve.BestBlock"
+                for s in s1.tracer.snapshot()
+            )
+            assert not any(
+                s.name == "bridge.serve.BestBlock"
+                for s in s2.tracer.snapshot()
+            )
+        finally:
+            c1.close(); c2.close()
+            s1.stop(); s2.stop()
+
+    def test_use_tracer_is_thread_scoped_and_nested(self):
+        a, b = Tracer(), Tracer()
+        a.enable(); b.enable()
+        assert current_tracer() is tracer
+        with use_tracer(a):
+            assert current_tracer() is a
+            with use_tracer(b):
+                assert current_tracer() is b
+            assert current_tracer() is a
+        assert current_tracer() is tracer
+
+    def test_service_board_owns_one_tracer(self, tmp_path):
+        """The board's tracer is THE ring its bridge serves from."""
+        from khipu_tpu.service_board import ServiceBoard
+
+        board = ServiceBoard(CFG)
+        try:
+            assert isinstance(board.tracer, Tracer)
+            assert board.tracer is not tracer
+            port = board.start_bridge(port=0)
+            assert port > 0
+            assert board._bridge_server.tracer is board.tracer
+        finally:
+            board.shutdown()
