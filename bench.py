@@ -848,7 +848,267 @@ def bench_replay_chaos(seed=0, n_blocks=32, txs_per_block=50, window=4,
     )
 
 
+def _serve_setup(n_blocks, txs_per_block, window=2, depth=2):
+    """Fixture chain + fresh target + serving plane wired the way
+    ServiceBoard.start_serving does it, but with bench-scaled admission
+    capacity (in-process dispatch is ~100x faster than a socket path,
+    so the production limits would never saturate in-harness)."""
+    import dataclasses
+
+    from khipu_tpu.config import ServingConfig, SyncConfig, fixture_config
+    from khipu_tpu.domain.block import Block as _Block
+    from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+    from khipu_tpu.domain.transaction import Transaction, sign_transaction
+    from khipu_tpu.jsonrpc import EthService, JsonRpcServer
+    from khipu_tpu.serving import AdmissionController, ReadView, ServingPlane
+    from khipu_tpu.serving.admission import (
+        journal_pressure,
+        pipeline_pressure,
+        txpool_pressure,
+    )
+    from khipu_tpu.storage.storages import Storages
+    from khipu_tpu.sync.chain_builder import ChainBuilder
+    from khipu_tpu.txpool import PendingTransactionsPool
+
+    # short queue + short wait: an admitted request may absorb at most
+    # ~4ms of queueing, keeping the admitted tail near the baseline
+    # tail — excess beyond that sheds instead of waiting
+    serve_cfg = ServingConfig(queue_timeout=0.004, max_queue=4)
+    cfg = dataclasses.replace(
+        fixture_config(chain_id=1),
+        sync=SyncConfig(
+            parallel_tx=False, commit_window_blocks=window,
+            pipeline_depth=depth,
+        ),
+        serving=serve_cfg,
+    )
+    nsenders = 8
+    keys, addrs = _replay_keys(nsenders)
+    receivers = [
+        bytes.fromhex("%040x" % (0xFEED0000 + i)) for i in range(32)
+    ]
+    alloc = {a: 10**24 for a in addrs}
+    builder = ChainBuilder(
+        Blockchain(Storages(), cfg), cfg, GenesisSpec(alloc=alloc)
+    )
+    blocks = []
+    nonces = [0] * nsenders
+    for n in range(n_blocks):
+        txs = []
+        for j in range(txs_per_block):
+            i = j % nsenders
+            txs.append(
+                sign_transaction(
+                    Transaction(
+                        nonces[i], 10**9, 21_000,
+                        receivers[(j * 7 + n) % len(receivers)],
+                        1_000 + n,
+                    ),
+                    keys[i], chain_id=1,
+                )
+            )
+            nonces[i] += 1
+        blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
+    wire = [_Block.decode(b.encode()) for b in blocks]
+    target = Blockchain(Storages(), cfg)
+    target.load_genesis(GenesisSpec(alloc=alloc))
+
+    # small pool so the write backlog the load phases build (no miner
+    # drains it) organically trips txpool_pressure past shed_write_at —
+    # the overload step then sheds with -32005 the way a saturated node
+    # would, not via an injected signal. Sized so the baseline + normal
+    # phases (~140 writes at the mixed profile's 10%) stay under the
+    # 0.85 write threshold and the 4x step is what crosses it
+    pool = PendingTransactionsPool(capacity=192)
+    read_view = ReadView(target)
+    admission = AdmissionController(
+        serve_cfg,
+        limits={"cheap": 4, "read": 4, "execute": 2, "write": 2},
+        signals=[
+            pipeline_pressure(),
+            journal_pressure(target.storages, depth),
+            txpool_pressure(pool),
+        ],
+    )
+    plane = ServingPlane(serve_cfg, read_view=read_view,
+                         admission=admission)
+    service = EthService(
+        target, cfg, pool, read_view=read_view, serving=plane
+    )
+    server = JsonRpcServer(service, serving=plane)
+    return cfg, target, wire, addrs, receivers, plane, service, server
+
+
+def bench_serve(smoke=False):
+    """``bench.py --serve``: the serving-plane bench — mixed RPC load
+    against a node MID-SYNC (the windowed pipelined replay importing
+    blocks on another thread), with the loadgen's read-your-writes
+    checker on. Three phases: (A) unloaded read-only baseline p99,
+    (B) >=1000 mixed RPCs while the pipeline imports (the headline
+    qps/p50/p99/shed line), (C) a 4x client step over the configured
+    capacity — admission sheds -32005 while the p99 of ADMITTED
+    requests stays bounded (vs collapsing for everyone, which is what
+    the unbounded thread-per-request default does)."""
+    import threading
+
+    from khipu_tpu.serving.loadgen import (
+        MIXED,
+        InProcessTransport,
+        LoadGenerator,
+    )
+    from khipu_tpu.sync.replay import ReplayDriver
+
+    n_blocks = 6 if smoke else 48
+    (cfg, target, wire, addrs, receivers, plane, service,
+     server) = _serve_setup(n_blocks, txs_per_block=6)
+    transport = InProcessTransport(server)
+    nonce_addrs = ["0x" + a.hex() for a in addrs]
+    # balances are checked on ACCUMULATE-ONLY addresses (receivers +
+    # coinbase): monotone by construction, so any regression the
+    # checker sees is a real torn/stale read
+    balance_addrs = ["0x" + r.hex() for r in receivers]
+    balance_addrs.append("0x" + (b"\xaa" * 20).hex())
+
+    def gen(profile, clients, reqs, seed, key_base):
+        return LoadGenerator(
+            transport, profile, clients=clients, seed=seed,
+            max_requests=reqs,
+            nonce_addresses=nonce_addrs,
+            balance_addresses=balance_addrs,
+            client_keys=[
+                (key_base + i).to_bytes(32, "big")
+                for i in range(clients)
+            ],
+            chain_id=1,
+        )
+
+    # ALL phases run MID-SYNC: the pipelined replay imports the
+    # fixture on its own thread, throttled so the import (and its
+    # seal/collect window traffic) spans the whole load run. The
+    # baseline too — the overload ratio must isolate what OVERLOAD
+    # does to admitted requests, not what sharing a GIL with the
+    # replay thread does to everything
+    driver = ReplayDriver(target, cfg, read_view=plane.read_view)
+    delay = 0.01 if smoke else 0.05
+
+    def throttled():
+        import time as _t
+
+        for b in wire:
+            yield b
+            _t.sleep(delay)
+
+    sync_done = threading.Event()
+
+    def run_sync():
+        try:
+            driver.replay(throttled())
+        finally:
+            sync_done.set()
+
+    sync_thread = threading.Thread(target=run_sync, daemon=True)
+    sync_thread.start()
+
+    # phase A: light-load baseline — SAME mixed profile as the loaded
+    # phases (comparing a cheap-reads-only baseline against a mix that
+    # includes eth_call would skew the overload ratio by method mix,
+    # not by load)
+    baseline = gen(MIXED, 2, 50 if smoke else 200, 11,
+                   0x0A11_0000).run()
+    p99_unloaded = baseline.p99()
+    baseline_mid_sync = not sync_done.is_set()
+
+    mixed = gen(MIXED, 4, 25 if smoke else 250, 22, 0x0B22_0000).run()
+    mid_sync = not sync_done.is_set()  # the load really ran mid-import
+
+    # phase C: 4x the client count over the same capacity
+    overload = gen(MIXED, 16, 10 if smoke else 75, 33,
+                   0x0C33_0000).run()
+    overload_mid_sync = not sync_done.is_set()
+    sync_thread.join(timeout=120)
+
+    violations = (
+        len(mixed.violations) + len(overload.violations)
+        + len(baseline.violations)
+    )
+    if smoke:
+        # force one real -32005 through the whole stack (pressure pins
+        # high -> write class sheds), so the exposition check below
+        # covers the shed family too
+        plane.admission.signals.append(lambda: 1.0)
+        resp = transport.call("eth_sendRawTransaction", ["0x00"])
+        assert resp.get("error", {}).get("code") == -32005, resp
+        plane.admission.signals.pop()
+        text = service.khipu_metrics_text()
+        lat = text.count("# TYPE khipu_rpc_latency_seconds histogram")
+        shed = text.count("# TYPE khipu_rpc_shed_total counter")
+        assert lat == 1, f"latency histogram TYPE lines: {lat}"
+        assert shed == 1, f"shed counter TYPE lines: {shed}"
+        assert violations == 0, (
+            mixed.violations + overload.violations
+        )
+        emit(
+            "serve_smoke", mixed.requests + overload.requests,
+            "requests",
+            violations=violations,
+            exposition_families_ok=True,
+            slo_methods=len(plane.slo.evaluate()["methods"]),
+        )
+        return
+
+    assert mixed.requests >= 1000, mixed.requests
+    assert violations == 0, (
+        baseline.violations + mixed.violations + overload.violations
+    )[:5]
+    assert overload.shed > 0, "4x step produced no -32005 sheds"
+    p99_admitted = overload.p99()
+    # admitted requests must not collapse: overload p99 stays within
+    # 5x the worse of (unloaded, mid-sync-normal-load) p99 — the whole
+    # point of shedding excess instead of queueing it
+    p99_floor = max(p99_unloaded, mixed.p99())
+    assert p99_admitted <= 5 * p99_floor, (
+        f"admitted p99 collapsed under overload: "
+        f"{p99_admitted * 1e3:.3f}ms vs floor {p99_floor * 1e3:.3f}ms"
+    )
+    budget = plane.slo.evaluate()["errorBudget"]
+    emit(
+        "rpc_mid_sync_qps",
+        round(mixed.qps, 1),
+        "req/s",
+        rpc_p50_ms=round(mixed.p50() * 1e3, 3),
+        rpc_p99_ms=round(mixed.p99() * 1e3, 3),
+        shed_rate=round(mixed.shed_rate, 4),
+        requests=mixed.requests,
+        mid_sync=mid_sync,
+        baseline_mid_sync=baseline_mid_sync,
+        p99_unloaded_ms=round(p99_unloaded * 1e3, 3),
+        ryw_violations=violations,
+        note="mixed profile, RYW checker on, windowed pipeline "
+             "importing on a background thread",
+    )
+    emit(
+        "rpc_overload_shed_rate",
+        round(overload.shed_rate, 4),
+        "fraction",
+        clients_step="4x",
+        shed=overload.shed,
+        requests=overload.requests,
+        mid_sync=overload_mid_sync,
+        p99_admitted_ms=round(p99_admitted * 1e3, 3),
+        p99_unloaded_ms=round(p99_unloaded * 1e3, 3),
+        p99_admitted_vs_unloaded=round(
+            p99_admitted / p99_unloaded if p99_unloaded else 0, 2
+        ),
+        error_budget_consumed=budget["budgetConsumed"],
+        note="admitted p99 must stay bounded while excess load sheds "
+             "with -32005 (SEDA-style staged admission)",
+    )
+
+
 def main() -> None:
+    if "--serve" in sys.argv:
+        bench_serve(smoke="--smoke" in sys.argv)
+        return
     for arg in sys.argv[1:]:
         if arg.startswith("--chaos"):
             seed = int(arg.split("=", 1)[1]) if "=" in arg else 0

@@ -178,6 +178,45 @@ class ClusterConfig:
 
 
 @dataclass(frozen=True)
+class ServingConfig:
+    """Serving plane (serving/ package — docs/serving.md): SLO-aware
+    admission control + read-your-writes view between the JSON-RPC
+    server and the sync/storage stack.
+
+    The plane is opt-in (``ServiceBoard.start_serving``); a bare
+    ``JsonRpcServer`` keeps the zero-overhead direct-dispatch path.
+    Per-class concurrency limits adapt by AIMD around the latency
+    targets; pressure signals (window-pipeline occupancy, commit-journal
+    depth, txpool fill) shed work class-by-class before queues melt
+    (Welsh's SEDA staged admission; Dean & Barroso's p99-first SLO)."""
+
+    # JSON-RPC surface hardening (jsonrpc/server.py)
+    max_batch: int = 100  # requests per batch array
+    max_body_bytes: int = 2 << 20  # HTTP request body cap
+    # installed filters not polled within this TTL are evicted
+    # (jsonrpc/filters.py; geth's 5-minute deadline)
+    filter_ttl: float = 300.0
+    # bounded admission queue: a request waits at most this long for a
+    # concurrency slot, and at most ``max_queue`` requests wait per
+    # class — beyond either bound it is shed with -32005
+    queue_timeout: float = 0.25
+    max_queue: int = 64
+    # AIMD concurrency limiter (admission.py): additive increase per
+    # under-target completion, multiplicative decrease (x beta) per
+    # over-target completion, at most once per ``decrease_cooldown``
+    aimd_beta: float = 0.7
+    decrease_cooldown: float = 0.1
+    # pressure level in [0,1] at which each cost class starts shedding
+    # (writes go first, cheap reads last); >1 disables pressure sheds
+    shed_write_at: float = 0.85
+    shed_execute_at: float = 0.90
+    shed_read_at: float = 0.95
+    # SLO objective: fraction of requests that must be admitted and
+    # answered without an internal error (error-budget readout)
+    objective: float = 0.999
+
+
+@dataclass(frozen=True)
 class FaultConfig:
     """Deterministic fault injection (chaos/ package — docs/recovery.md).
 
@@ -202,6 +241,7 @@ class KhipuConfig:
         default_factory=ObservabilityConfig
     )
     faults: FaultConfig = field(default_factory=FaultConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
 
 
 def fixture_config(

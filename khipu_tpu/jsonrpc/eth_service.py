@@ -58,10 +58,27 @@ class EthService:
         tx_pool: Optional[PendingTransactionsPool] = None,
         cluster=None,
         tracer=None,
+        read_view=None,
+        serving=None,
     ):
         self.blockchain = blockchain
         self.config = config
-        self.tx_pool = tx_pool or PendingTransactionsPool()
+        # `is None`, not `or`: an EMPTY pool is falsy (__len__ == 0),
+        # and `or` would silently swap the caller's pool for a private
+        # one — sendRawTransaction would then land txs the rest of the
+        # node (miner, pressure signals) never sees
+        self.tx_pool = (
+            tx_pool if tx_pool is not None else PendingTransactionsPool()
+        )
+        # read-your-writes overlay (serving/readview.py): when set,
+        # account reads at latest/pending resolve through it so
+        # executed-but-not-yet-persisted window state is visible and
+        # per-key reads never regress mid-pipeline
+        self.read_view = read_view
+        # the serving plane (admission + SLO), surfaced in
+        # khipu_metrics; dispatch-side enforcement lives in
+        # JsonRpcServer, which holds the same object
+        self.serving = serving
         # sharded node-cache cluster client (cluster/client.py); when
         # set, khipu_metrics surfaces its per-shard counters
         self.cluster = cluster
@@ -75,7 +92,9 @@ class EthService:
 
         # eager: a lazy-init race under concurrent RPC threads could
         # orphan one client's installed filter ids
-        self._filter_manager = FilterManager(blockchain)
+        self._filter_manager = FilterManager(
+            blockchain, ttl=config.serving.filter_ttl
+        )
         # chain-head + store-cache samples for the unified registry
         # (replace-by-key: the newest service owns the slot)
         try:
@@ -141,21 +160,33 @@ class EthService:
 
     # -------------------------------------------------------------- eth
 
+    # tags the ReadView overlay serves (numeric/historic tags always
+    # read the committed store — the overlay only covers the head)
+    _HEAD_TAGS = ("latest", "pending", "safe", "finalized")
+
     def eth_blockNumber(self) -> str:
+        if self.read_view is not None:
+            return qty(self.read_view.head_number())
         return qty(self.blockchain.best_block_number)
 
     def eth_getBalance(self, address: str, tag="latest") -> str:
+        addr = parse_data(address)
+        if self.read_view is not None and tag in self._HEAD_TAGS:
+            _, acc = self.read_view.get_account(addr)
+            return qty(acc.balance if acc else 0)
         header = self._header(tag)
-        acc = self.blockchain.get_account(
-            parse_data(address), header.state_root
-        )
+        acc = self.blockchain.get_account(addr, header.state_root)
         return qty(acc.balance if acc else 0)
 
     def eth_getTransactionCount(self, address: str, tag="latest") -> str:
-        header = self._header(tag)
         addr = parse_data(address)
-        acc = self.blockchain.get_account(addr, header.state_root)
-        count = acc.nonce if acc else 0
+        if self.read_view is not None and tag in self._HEAD_TAGS:
+            _, acc = self.read_view.get_account(addr)
+            count = acc.nonce if acc else 0
+        else:
+            header = self._header(tag)
+            acc = self.blockchain.get_account(addr, header.state_root)
+            count = acc.nonce if acc else 0
         if tag == "pending":
             # pooled txs advance the usable nonce (wallets pick the next
             # nonce from the pending count)
@@ -381,7 +412,14 @@ class EthService:
         stx = SignedTransaction.decode(parse_data(raw))
         if stx.sender is None:
             raise RpcError(-32000, "invalid signature")
-        self.tx_pool.add(stx)
+        if not self.tx_pool.add(stx):
+            # geth parity: a rejected add is an ERROR, not a silent
+            # hash — the wallet must know its tx is not in the pool
+            if self.tx_pool.get(stx.hash) is not None:
+                raise RpcError(-32000, "already known")
+            raise RpcError(
+                -32000, "replacement transaction underpriced"
+            )
         return data(stx.hash)
 
     def eth_pendingTransactions(self) -> List[dict]:
@@ -551,6 +589,15 @@ class EthService:
             ),
             "faults": fault_log.snapshot(),
         }
+        # serving plane (serving/__init__.py): admission limits /
+        # sheds, per-method SLO evaluation + error budget, read-view
+        # overlay occupancy
+        if self.serving is not None:
+            out["serving"] = self.serving.snapshot()
+        elif self.read_view is not None:
+            out["serving"] = {"readView": self.read_view.snapshot()}
+        # installed-filter occupancy + TTL evictions (jsonrpc/filters)
+        out["filters"] = self._filter_manager.snapshot()
         # the unified-registry superset: every registered instrument +
         # pull collector in one consistent snapshot (the same samples
         # khipu_metrics_text exposes), plus the per-phase latency

@@ -125,28 +125,84 @@ def get_logs(blockchain: Blockchain, query: LogQuery) -> List[LogHit]:
 
 class FilterManager:
     """Installed filters with poll semantics (eth_newFilter /
-    eth_getFilterChanges / eth_uninstallFilter)."""
+    eth_getFilterChanges / eth_uninstallFilter).
 
-    def __init__(self, blockchain: Blockchain):
+    Filters a client stops polling are EVICTED after ``ttl`` seconds
+    (geth's 5-minute filter deadline): every installed filter holds
+    server-side state — a log filter's cursor pins incremental scans,
+    a pending-tx filter's cursor pins the pool's arrival journal — so
+    an abandoned one is a slow leak an open endpoint accumulates
+    forever. The sweep is lazy (piggybacked on install/poll under the
+    manager lock): no timer thread, and a filter polled within its TTL
+    is never touched."""
+
+    def __init__(self, blockchain: Blockchain, ttl: float = 300.0):
         self.blockchain = blockchain
+        self.ttl = ttl
         self._ids = itertools.count(1)
         self._filters = {}
+        self._last_poll = {}  # fid -> monotonic time of last touch
         self._lock = threading.Lock()
+        self.evictions = 0
+        try:
+            from khipu_tpu.observability.registry import REGISTRY
+
+            REGISTRY.register_collector(
+                "filters", self._registry_samples
+            )
+        except Exception:
+            pass
+
+    def _registry_samples(self) -> list:
+        with self._lock:
+            active = len(self._filters)
+            evicted = self.evictions
+        return [
+            ("khipu_filters_active", "gauge", {}, active),
+            ("khipu_filter_evictions_total", "counter", {}, evicted),
+        ]
+
+    def _now(self) -> float:
+        import time
+
+        return time.monotonic()
+
+    def _sweep(self) -> None:
+        """Evict TTL-expired filters (caller holds the lock)."""
+        deadline = self._now() - self.ttl
+        for fid in [
+            f for f, t in self._last_poll.items() if t < deadline
+        ]:
+            self._filters.pop(fid, None)
+            self._last_poll.pop(fid, None)
+            self.evictions += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "active": len(self._filters),
+                "evictions": self.evictions,
+                "ttlSeconds": self.ttl,
+            }
 
     def new_log_filter(self, query: LogQuery) -> int:
         with self._lock:
+            self._sweep()
             fid = next(self._ids)
             # first poll catches up from the query's fromBlock (geth
             # semantics); later polls return only the delta
             self._filters[fid] = ("logs", query, query.from_block - 1)
+            self._last_poll[fid] = self._now()
             return fid
 
     def new_block_filter(self) -> int:
         with self._lock:
+            self._sweep()
             fid = next(self._ids)
             self._filters[fid] = (
                 "blocks", None, self.blockchain.best_block_number
             )
+            self._last_poll[fid] = self._now()
             return fid
 
     def new_pending_tx_filter(self, tx_pool) -> int:
@@ -154,8 +210,10 @@ class FilterManager:
         — read from the pool's arrival journal, so a tx that enters and
         is mined/evicted between polls is still reported."""
         with self._lock:
+            self._sweep()
             fid = next(self._ids)
             self._filters[fid] = ("pending", tx_pool, tx_pool.cursor())
+            self._last_poll[fid] = self._now()
             return fid
 
     def get_log_query(self, fid: int):
@@ -163,12 +221,15 @@ class FilterManager:
         eth_getFilterLogs must not poke at internals)."""
         with self._lock:
             entry = self._filters.get(fid)
+            if entry is not None:
+                self._last_poll[fid] = self._now()  # a poll, TTL-wise
         if entry is None or entry[0] != "logs":
             return None
         return entry[1]
 
     def uninstall(self, fid: int) -> bool:
         with self._lock:
+            self._last_poll.pop(fid, None)
             return self._filters.pop(fid, None) is not None
 
     # one poll never scans more than this many blocks; the cursor
@@ -183,9 +244,11 @@ class FilterManager:
             # concurrent polls of one filter must neither double-deliver
             # nor rewind the cursor (the pool lock nests inside and
             # nothing takes them in the reverse order)
+            self._sweep()
             entry = self._filters.get(fid)
             if entry is None:
                 return None
+            self._last_poll[fid] = self._now()
             kind, query, last_seen = entry
             if kind == "pending":
                 tx_pool = query

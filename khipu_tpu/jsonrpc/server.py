@@ -20,14 +20,31 @@ _ALLOWED_PREFIXES = ("eth_", "net_", "web3_", "khipu_", "personal_")
 
 class JsonRpcServer:
     def __init__(self, service: EthService, host: str = "127.0.0.1",
-                 port: int = 8546, extra_services: tuple = ()):
+                 port: int = 8546, extra_services: tuple = (),
+                 serving=None, max_batch: int = 100,
+                 max_body_bytes: int = 2 << 20):
         """``extra_services`` are additional dispatch targets searched
         after the primary service (PersonalService installs here —
-        JsonRpcController's per-namespace handler tables)."""
+        JsonRpcController's per-namespace handler tables).
+
+        ``serving`` is an optional admission/SLO plane
+        (serving.ServingPlane): when set, every resolvable method
+        passes ``admit``/``finish`` around dispatch — over-limit
+        requests come back ``-32005`` instead of queueing in the
+        ThreadingHTTPServer without bound. ``max_batch`` /
+        ``max_body_bytes`` bound what one POST can ask for (a single
+        huge batch is otherwise an amplification lever no concurrency
+        limit sees — one socket, thousands of dispatches)."""
         self.service = service
         self.services = (service, *extra_services)
         self.host = host
         self.port = port
+        self.serving = serving
+        if serving is not None and serving.config is not None:
+            max_batch = serving.config.max_batch
+            max_body_bytes = serving.config.max_body_bytes
+        self.max_batch = max_batch
+        self.max_body_bytes = max_body_bytes
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -46,6 +63,15 @@ class JsonRpcServer:
 
     def handle(self, request: Any, browser_origin: bool = False) -> Any:
         if isinstance(request, list):  # batch
+            if len(request) > self.max_batch:
+                return {
+                    "jsonrpc": "2.0", "id": None,
+                    "error": {
+                        "code": -32600,
+                        "message": f"batch too large "
+                        f"(max {self.max_batch})",
+                    },
+                }
             return [self._handle_one(r, browser_origin) for r in request]
         return self._handle_one(request, browser_origin)
 
@@ -78,14 +104,29 @@ class JsonRpcServer:
         )
         if fn is None:
             return {**base, "error": {"code": -32601, "message": f"method {method!r} not found"}}
+        # admission gate (serving/admission.py): resolvable methods
+        # only — unknown-method noise must not consume slots or skew
+        # the per-method SLO families
+        ticket = None
+        if self.serving is not None:
+            try:
+                ticket = self.serving.admit(method)
+            except RpcError as e:  # ServerBusy, already counted as shed
+                return {**base, "error": {"code": e.code, "message": str(e)}}
+        error = True
         try:
-            return {**base, "result": fn(*params)}
+            out = {**base, "result": fn(*params)}
+            error = False
+            return out
         except RpcError as e:
             return {**base, "error": {"code": e.code, "message": str(e)}}
         except TypeError as e:
             return {**base, "error": {"code": -32602, "message": f"invalid params: {e}"}}
         except Exception as e:  # internal error — never kill the server
             return {**base, "error": {"code": -32603, "message": f"{type(e).__name__}: {e}"}}
+        finally:
+            if ticket is not None:
+                self.serving.finish(method, ticket, error=error)
 
     # --------------------------------------------------------- server
 
@@ -95,6 +136,29 @@ class JsonRpcServer:
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
+                if length > outer.max_body_bytes:
+                    # refuse BEFORE reading: a spec-shaped error goes
+                    # back and the connection closes (the body is
+                    # unread, so the stream cannot be resynced)
+                    payload = json.dumps({
+                        "jsonrpc": "2.0", "id": None,
+                        "error": {
+                            "code": -32600,
+                            "message": "request body too large "
+                            f"(max {outer.max_body_bytes} bytes)",
+                        },
+                    }).encode()
+                    self.close_connection = True
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/json"
+                    )
+                    self.send_header(
+                        "Content-Length", str(len(payload))
+                    )
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 body = self.rfile.read(length)
                 try:
                     request = json.loads(body)

@@ -111,12 +111,19 @@ class WindowCommitter:
                  hasher: Hasher = host_hasher,
                  account_start_nonce: int = 0,
                  get_block_hash=None,
-                 fused: bool = False):
+                 fused: bool = False,
+                 on_block_committed=None):
         self.storages = storages
         self.hasher = hasher
         self.fused = fused  # one-dispatch finalize (trie/fused.py)
         self.account_start_nonce = account_start_nonce
         self.get_block_hash = get_block_hash or (lambda n: None)
+        # serving hook (serving/readview.py): called per commit_block
+        # with (header, {addr: Account | None}) — the exact account
+        # diff folded into the session, BEFORE any of it is durable.
+        # Must be cheap and must not raise (it runs on the driver
+        # thread inside the window critical path)
+        self.on_block_committed = on_block_committed
 
         # ONE placeholder namespace for every trie in the window
         self._logs: Dict[bytes, list] = {}
@@ -204,6 +211,8 @@ class WindowCommitter:
         self._pending_blocks.append(
             (header, trie.force_hashed_root())
         )
+        if self.on_block_committed is not None:
+            self.on_block_committed(header, final)
 
     def storage_session(self, root_ref) -> DeferredMPT:
         """A storage-trie session sharing the window namespace; root_ref

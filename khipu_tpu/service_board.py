@@ -63,6 +63,7 @@ class ServiceBoard:
         self._fast_sync = None
         self._cluster = None
         self._cluster_health = None
+        self._serving = None
 
     # ---------------------------------------------------------- node key
 
@@ -102,6 +103,11 @@ class ServiceBoard:
         service = EthService(
             self.blockchain, self.config, self.tx_pool,
             cluster=self._cluster, tracer=self.tracer,
+            read_view=(
+                self._serving.read_view
+                if self._serving is not None else None
+            ),
+            serving=self._serving,
         )
         extra = ()
         keystore_dir = key_dir or (
@@ -120,7 +126,8 @@ class ServiceBoard:
                 ),
             )
         self._rpc_server = JsonRpcServer(
-            service, host, port, extra_services=extra
+            service, host, port, extra_services=extra,
+            serving=self._serving,
         )
         return self._rpc_server.start()
 
@@ -222,6 +229,26 @@ class ServiceBoard:
     def cluster(self):
         return self._cluster
 
+    def start_serving(self, **kwargs):
+        """Stand up the serving plane (serving/ package —
+        docs/serving.md): the read-your-writes view + SLO-aware
+        admission control the RPC server and sync drivers share. Call
+        BEFORE start_rpc / start_regular_sync so both pick it up — the
+        order mirrors how the pieces depend on each other (the plane
+        needs only the blockchain and pool, the servers need the
+        plane)."""
+        from khipu_tpu.serving import ServingPlane
+
+        self._serving = ServingPlane.build(
+            self.blockchain, self.config, tx_pool=self.tx_pool,
+            **kwargs,
+        )
+        return self._serving
+
+    @property
+    def serving(self):
+        return self._serving
+
     def start_regular_sync(self, **kwargs):
         """Tip-following block import over the peer pool
         (RegularSyncService.scala role); requires start_network."""
@@ -230,6 +257,8 @@ class ServiceBoard:
         if self._peer_manager is None:
             raise RuntimeError("start_network first")
         kwargs.setdefault("cluster", self._cluster)
+        if self._serving is not None:
+            kwargs.setdefault("read_view", self._serving.read_view)
         self._regular_sync = RegularSyncService(
             self.blockchain, self.config, self._peer_manager, **kwargs
         )

@@ -72,6 +72,7 @@ class RegularSyncService:
         device_commit: bool = False,
         txpool=None,
         cluster=None,
+        read_view=None,
     ):
         self.blockchain = blockchain
         self.config = config
@@ -80,7 +81,8 @@ class RegularSyncService:
         self.timeout = request_timeout
         self.log = log or (lambda s: None)
         self._driver = ReplayDriver(
-            blockchain, config, device_commit=device_commit
+            blockchain, config, device_commit=device_commit,
+            read_view=read_view,
         )
         # serializes chain mutation between the pull loop and the
         # NewBlock push handler (which runs on peer reader threads)

@@ -291,9 +291,16 @@ class ReplayDriver:
         validate_headers: bool = True,
         device_commit: bool = False,
         tracer: Optional[Tracer] = None,
+        read_view=None,
     ):
         self.blockchain = blockchain
         self.config = config
+        # serving-plane read view (serving/readview.py): committed
+        # blocks publish their account diffs into it on the driver
+        # thread, durable windows retire them on the collector thread,
+        # and a pipeline abort invalidates everything above the
+        # committed best — RPC reads stay monotonic mid-pipeline
+        self.read_view = read_view
         # per-driver recorder: a driver handed its own Tracer (e.g. the
         # bridge server's — bridge.py) records there; the default stays
         # the module-global instance so single-driver processes and the
@@ -410,6 +417,10 @@ class ReplayDriver:
                 # per-level hasher loop would pay O(levels) tunnel
                 # round-trips per window (docs/roofline.md)
                 fused=self.hasher is not None,
+                on_block_committed=(
+                    self.read_view.publish_block
+                    if self.read_view is not None else None
+                ),
             )
 
         committer = make_committer(parent.state_root)
@@ -562,6 +573,11 @@ class ReplayDriver:
                     stats.gas += gas
                     stats.parallel_txs += ptxs
                     stats.conflicts += confl
+                # the window is durable (best advanced, commit mark
+                # down): the committed store now serves same-or-newer
+                # state, so the read-view overlay can let go of it
+                if self.read_view is not None:
+                    self.read_view.retire_through(hi)
                 t2 = time.perf_counter()
                 ph["collect_bg"] += t1 - t0
                 ph["save_bg"] += t2 - t1
@@ -686,6 +702,13 @@ class ReplayDriver:
             # re-raised collector failure) aborts the pipeline:
             # queued windows are dropped WITHOUT persisting
             collector.kill()
+            # un-durable overlay state must die with the windows that
+            # produced it — reads fall back to the committed store
+            # (never a torn window)
+            if self.read_view is not None:
+                self.read_view.invalidate_above(
+                    self.blockchain.best_block_number
+                )
             raise
         collector.close()
         stats.seconds = time.perf_counter() - t_start

@@ -22,23 +22,77 @@ class PendingTransactionsPool:
         self.capacity = capacity
         # insertion order IS the eviction order (oldest first)
         self._txs: "OrderedDict[bytes, SignedTransaction]" = OrderedDict()
+        # (sender, nonce) -> tx hash: the replacement index — at most
+        # ONE pooled tx per account slot (geth's price-bump rule,
+        # tx_pool.go: a same-nonce resubmission must outbid the pooled
+        # one or it is rejected as underpriced)
+        self._by_sender_nonce = {}
         # monotonic arrival journal: pending-tx filters read deltas from
         # it, so a tx that enters AND leaves between polls still reports
         self._arrivals: List[bytes] = []
         self._arrival_base = 0  # journal offset after trims
         self._lock = threading.Lock()
+        self.evictions = 0  # capacity evictions (oldest-first)
+        self.replacements = 0  # same-slot higher-price replacements
+        self.rejected_underpriced = 0  # same-slot non-outbidding adds
+        try:
+            from khipu_tpu.observability.registry import REGISTRY
+
+            REGISTRY.register_collector("txpool", self._registry_samples)
+        except Exception:
+            pass
+
+    def _registry_samples(self) -> list:
+        with self._lock:
+            return [
+                ("khipu_txpool_size", "gauge", {}, len(self._txs)),
+                ("khipu_txpool_capacity", "gauge", {}, self.capacity),
+                ("khipu_txpool_evictions_total", "counter", {},
+                 self.evictions),
+                ("khipu_txpool_replacements_total", "counter", {},
+                 self.replacements),
+                ("khipu_txpool_rejected_underpriced_total", "counter",
+                 {}, self.rejected_underpriced),
+            ]
+
+    def _drop(self, tx_hash: bytes) -> None:
+        """Remove one entry + its slot index (caller holds the lock)."""
+        stx = self._txs.pop(tx_hash, None)
+        if stx is None:
+            return
+        slot = (stx.sender, stx.tx.nonce)
+        if self._by_sender_nonce.get(slot) == tx_hash:
+            del self._by_sender_nonce[slot]
 
     def add(self, stx: SignedTransaction) -> bool:
-        """Add a signature-valid tx; returns False for duplicates.
+        """Add a signature-valid tx; returns False for duplicates and
+        for same-sender same-nonce resubmissions that do not outbid
+        the pooled tx's gas price (a strictly higher bid REPLACES it —
+        geth's replacement rule, so a stuck tx can be repriced).
         Oldest entries are evicted at capacity."""
         if stx.sender is None:
             raise ValueError("unrecoverable signature")
         with self._lock:
             if stx.hash in self._txs:
                 return False
+            slot = (stx.sender, stx.tx.nonce)
+            pooled_hash = self._by_sender_nonce.get(slot)
+            if pooled_hash is not None:
+                pooled = self._txs[pooled_hash]
+                if stx.tx.gas_price <= pooled.tx.gas_price:
+                    self.rejected_underpriced += 1
+                    return False
+                del self._txs[pooled_hash]  # outbid: replace in place
+                del self._by_sender_nonce[slot]
+                self.replacements += 1
             while len(self._txs) >= self.capacity:
-                self._txs.popitem(last=False)
+                oldest_hash, oldest = self._txs.popitem(last=False)
+                oslot = (oldest.sender, oldest.tx.nonce)
+                if self._by_sender_nonce.get(oslot) == oldest_hash:
+                    del self._by_sender_nonce[oslot]
+                self.evictions += 1
             self._txs[stx.hash] = stx
+            self._by_sender_nonce[slot] = stx.hash
             self._arrivals.append(stx.hash)
             # bound the journal: keep the most recent 4x capacity
             if len(self._arrivals) > 4 * self.capacity:
@@ -76,7 +130,8 @@ class PendingTransactionsPool:
         removed = 0
         with self._lock:
             for stx in txs:
-                if self._txs.pop(stx.hash, None) is not None:
+                if stx.hash in self._txs:
+                    self._drop(stx.hash)
                     removed += 1
         return removed
 

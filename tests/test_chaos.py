@@ -402,6 +402,105 @@ class TestDegrade:
         collector._thread.join(timeout=5)
 
 
+# ------------------------------------------------------ serving chaos
+
+
+class TestServingUnderCollectorDeath:
+    def test_collector_dies_under_load_sheds_no_torn_reads(self, chain):
+        """The serving-plane chaos scenario (docs/serving.md): mixed
+        RPC load drives a node mid-import, the window collector DIES
+        under it, and the degrade path takes over. Required outcomes:
+        the write backlog trips pressure shedding (-32005) instead of
+        unbounded queueing, the read-your-writes checker sees zero
+        regressions across the death (no torn-window reads), and the
+        chain the degraded import lands on is bit-exact."""
+        from khipu_tpu.config import ServingConfig
+        from khipu_tpu.jsonrpc import EthService, JsonRpcServer
+        from khipu_tpu.serving import AdmissionController, ReadView, ServingPlane
+        from khipu_tpu.serving.admission import (
+            pipeline_pressure,
+            txpool_pressure,
+        )
+        from khipu_tpu.serving.loadgen import (
+            MIXED,
+            InProcessTransport,
+            LoadGenerator,
+        )
+        from khipu_tpu.txpool import PendingTransactionsPool
+
+        cfg = dataclasses.replace(
+            _cfg(window=2, depth=2, degrade=True),
+            serving=ServingConfig(queue_timeout=0.01, max_queue=8),
+        )
+        bc = _fresh(cfg)
+        rv = ReadView(bc)
+        # tiny pool: the MIXED profile's write stream (~10%) fills it
+        # mid-run, so pressure shedding MUST kick in under this load
+        pool = PendingTransactionsPool(capacity=24)
+        plane = ServingPlane(
+            cfg.serving, read_view=rv,
+            admission=AdmissionController(
+                cfg.serving,
+                signals=[pipeline_pressure(), txpool_pressure(pool)],
+            ),
+        )
+        service = EthService(bc, cfg, pool, read_view=rv, serving=plane)
+        server = JsonRpcServer(service, serving=plane)
+
+        deaths0 = PIPELINE_GAUGES["collector_deaths"]
+        sync0 = PIPELINE_GAUGES["sync_fallback_windows"]
+
+        def throttled():
+            for b in chain:
+                yield b
+                time.sleep(0.005)
+
+        result = {}
+
+        def run_sync():
+            plan = FaultPlan(
+                seed=9,
+                rules=[FaultRule("collector.collect", "die", after=1,
+                                 times=1)],
+            )
+            with active(plan):
+                result["stats"] = ReplayDriver(
+                    bc, cfg, read_view=rv
+                ).replay(throttled())
+
+        sync_thread = threading.Thread(target=run_sync, daemon=True)
+        sync_thread.start()
+        report = LoadGenerator(
+            InProcessTransport(server), MIXED, clients=4,
+            max_requests=150, seed=5,
+            nonce_addresses=["0x" + a.hex() for a in ADDRS],
+            # the only accumulate-only address in this fixture: senders
+            # pay fees, so their balances legitimately move both ways
+            balance_addresses=["0x" + MINER.hex()],
+            chain_id=1,
+        ).run()
+        sync_thread.join(timeout=60)
+        assert not sync_thread.is_alive()
+
+        # import survived the death via the degrade path
+        assert result["stats"].blocks == N_BLOCKS
+        assert PIPELINE_GAUGES["collector_deaths"] == deaths0 + 1
+        assert PIPELINE_GAUGES["sync_fallback_windows"] > sync0
+        # shed rate rose: the backlog tripped pressure sheds (-32005)
+        assert report.shed > 0
+        snap = plane.admission.snapshot()
+        assert snap["write"]["shed"]["pressure"] > 0
+        # zero read-your-writes violations across the death: no torn
+        # or backwards state was ever served
+        assert report.violations == [], report.violations[:5]
+        assert report.ok > 0
+        # the overlay drained: reads now resolve at the durable head
+        assert rv.head_number() == bc.best_block_number == N_BLOCKS
+        assert rv.snapshot()["overlayAddrs"] == 0
+        # and the degraded chain is bit-exact vs the clean oracle
+        _assert_same_chain(bc, _clean_reference(chain))
+
+
 # ------------------------------------------------------ cluster chaos
 
 

@@ -1,0 +1,793 @@
+"""Serving plane: admission control, read-your-writes view, SLO
+tracking, RPC surface hardening, and the load harness.
+
+Fast tests run in tier-1. The heavy multi-threaded load tests carry
+``@pytest.mark.serve`` (AND ``slow``, so the default `-m "not slow"`
+run skips them); run them with `pytest -m serve`.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.config import ServingConfig, SyncConfig, fixture_config
+from khipu_tpu.domain.account import Account
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.jsonrpc import EthService, JsonRpcServer
+from khipu_tpu.jsonrpc.filters import FilterManager, LogQuery
+from khipu_tpu.serving import (
+    AdmissionController,
+    ReadView,
+    ServerBusy,
+    ServingPlane,
+    SloTracker,
+    classify_method,
+)
+from khipu_tpu.serving.admission import txpool_pressure
+from khipu_tpu.serving.loadgen import (
+    MIXED,
+    READ_ONLY,
+    HttpTransport,
+    InProcessTransport,
+    LoadGenerator,
+    WorkloadProfile,
+)
+from khipu_tpu.serving.slo import LATENCY_BUCKETS, quantile
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.txpool import PendingTransactionsPool
+
+CFG = fixture_config(chain_id=1)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(3)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ETH = 10**18
+ALLOC = {a: 1000 * ETH for a in ADDRS}
+MINER = b"\xaa" * 20
+
+
+def _tx(key, nonce, to, value, gas_price=10**9):
+    return sign_transaction(
+        Transaction(nonce, gas_price, 21_000, to, value),
+        key, chain_id=1,
+    )
+
+
+def _fresh():
+    bc = Blockchain(Storages(), CFG)
+    bc.load_genesis(GenesisSpec(alloc=ALLOC))
+    return bc
+
+
+@pytest.fixture(scope="module")
+def chain_bc():
+    """A 4-block chain of transfers for read-path tests."""
+    builder = ChainBuilder(
+        Blockchain(Storages(), CFG), CFG, GenesisSpec(alloc=ALLOC)
+    )
+    nonces = [0, 0, 0]
+    for n in range(4):
+        i = n % len(KEYS)
+        builder.add_block(
+            [_tx(KEYS[i], nonces[i], ADDRS[(i + 1) % 3], 100 + n)],
+            coinbase=MINER,
+        )
+        nonces[i] += 1
+    return builder.blockchain
+
+
+# ------------------------------------------------------- admission
+
+
+class TestClassify:
+    def test_table_prefix_and_default(self):
+        assert classify_method("eth_call") == "execute"
+        assert classify_method("eth_sendRawTransaction") == "write"
+        assert classify_method("eth_blockNumber") == "cheap"
+        assert classify_method("net_version") == "cheap"
+        assert classify_method("personal_sign") == "write"
+        assert classify_method("khipu_metrics") == "read"
+        # unknown eth_* state reads default to the read class
+        assert classify_method("eth_getBalance") == "read"
+        assert classify_method("eth_somethingNew") == "read"
+
+
+class TestAdmission:
+    def _ctl(self, **kw):
+        cfg = kw.pop("cfg", ServingConfig(queue_timeout=0.02,
+                                          max_queue=2))
+        return AdmissionController(cfg, **kw)
+
+    def test_acquire_release_counts(self):
+        ctl = self._ctl(limits={"read": 2})
+        t1 = ctl.acquire("eth_getBalance")
+        t2 = ctl.acquire("eth_getBalance")
+        snap = ctl.snapshot()
+        assert snap["read"]["inflight"] == 2
+        assert snap["read"]["peakInflight"] == 2
+        ctl.release(t1)
+        ctl.release(t2)
+        assert ctl.snapshot()["read"]["inflight"] == 0
+
+    def test_over_limit_sheds_after_timeout(self):
+        ctl = self._ctl(limits={"execute": 2})
+        ctl.acquire("eth_call")
+        ctl.acquire("eth_call")
+        with pytest.raises(ServerBusy):
+            ctl.acquire("eth_call")  # queue, then 20ms timeout, shed
+        assert ctl.snapshot()["execute"]["shed"]["queueTimeout"] == 1
+
+    def test_full_queue_sheds_immediately(self):
+        cfg = ServingConfig(queue_timeout=5.0, max_queue=0)
+        ctl = AdmissionController(cfg, limits={"write": 2})
+        ctl.acquire("eth_sendRawTransaction")
+        ctl.acquire("eth_sendRawTransaction")
+        t0 = time.monotonic()
+        with pytest.raises(ServerBusy):
+            ctl.acquire("eth_sendRawTransaction")
+        assert time.monotonic() - t0 < 1.0  # no queue: instant shed
+        assert ctl.snapshot()["write"]["shed"]["queueFull"] == 1
+
+    def test_released_slot_admits_queued_waiter(self):
+        ctl = self._ctl(cfg=ServingConfig(queue_timeout=2.0,
+                                          max_queue=2),
+                        limits={"read": 2})
+        t1 = ctl.acquire("eth_getBalance")
+        ctl.acquire("eth_getBalance")
+        got = []
+
+        def waiter():
+            got.append(ctl.acquire("eth_getBalance"))
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.05)
+        ctl.release(t1)  # frees the slot the waiter is queued for
+        th.join(timeout=5)
+        assert got and got[0] is not None
+
+    def test_aimd_grows_under_target_and_cuts_over(self):
+        cfg = ServingConfig(decrease_cooldown=0.0)
+        ctl = AdmissionController(cfg, limits={"read": 4},
+                                  targets={"read": 0.050})
+        for _ in range(40):  # fast completions: additive increase
+            ctl.release(ctl.acquire("eth_getBalance"))
+        grown = ctl.snapshot()["read"]["limit"]
+        assert grown > 4
+        # one over-target completion: multiplicative decrease
+        lim = ctl._classes["read"]
+        exact = lim.limit
+        lim.release(seconds=1.0)
+        lim.inflight += 1  # undo the release bookkeeping for the fake
+        assert lim.limit == pytest.approx(exact * cfg.aimd_beta)
+
+    def test_decrease_cooldown_bounds_the_cut_rate(self):
+        cfg = ServingConfig(decrease_cooldown=60.0)
+        ctl = AdmissionController(cfg, limits={"read": 100})
+        lim = ctl._classes["read"]
+        lim.inflight = 2
+        lim.release(seconds=9.9)
+        after_first = lim.limit
+        lim.release(seconds=9.9)  # within cooldown: no second cut
+        assert lim.limit == after_first
+
+    def test_pressure_sheds_writes_first_cheap_never(self):
+        pressure = {"v": 0.0}
+        ctl = self._ctl(signals=[lambda: pressure["v"]])
+        cfg = ctl.config
+        pressure["v"] = (cfg.shed_write_at + cfg.shed_execute_at) / 2
+        with pytest.raises(ServerBusy):
+            ctl.acquire("eth_sendRawTransaction")
+        # same pressure: execute/read/cheap still admitted
+        for m in ("eth_call", "eth_getBalance", "eth_blockNumber"):
+            ctl.release(ctl.acquire(m))
+        pressure["v"] = 1.0  # saturated: everything but cheap sheds
+        for m in ("eth_sendRawTransaction", "eth_call",
+                  "eth_getBalance"):
+            with pytest.raises(ServerBusy):
+                ctl.acquire(m)
+        ctl.release(ctl.acquire("eth_blockNumber"))
+        assert ctl.snapshot()["write"]["shed"]["pressure"] == 2
+
+    def test_txpool_pressure_signal(self):
+        pool = PendingTransactionsPool(capacity=4)
+        sig = txpool_pressure(pool)
+        assert sig() == 0.0
+        for n in range(4):
+            pool.add(_tx(KEYS[0], n, ADDRS[1], 1))
+        assert sig() == 1.0
+
+    def test_registry_exposition_single_family(self):
+        from khipu_tpu.observability.registry import REGISTRY
+
+        self._ctl()  # register_collector replaces by key: no dup
+        text = REGISTRY.prometheus_text()
+        assert text.count("# TYPE khipu_admission_limit gauge") == 1
+        assert text.count(
+            "# TYPE khipu_admission_shed_total counter"
+        ) == 1
+
+
+# -------------------------------------------------------- read view
+
+
+class TestReadView:
+    def _header(self, number):
+        class H:
+            pass
+
+        h = H()
+        h.number = number
+        return h
+
+    def test_overlay_first_store_second(self, chain_bc):
+        rv = ReadView(chain_bc)
+        best = chain_bc.best_block_number
+        n0, acc0 = rv.get_account(ADDRS[0])
+        assert n0 == best and acc0 is not None
+        rv.publish_block(
+            self._header(best + 1),
+            {ADDRS[0]: Account(nonce=acc0.nonce + 1,
+                               balance=acc0.balance - 5)},
+        )
+        n1, acc1 = rv.get_account(ADDRS[0])
+        assert n1 == best + 1
+        assert acc1.nonce == acc0.nonce + 1
+        assert rv.head_number() == best + 1
+        # addresses the overlay does not cover fall through to store
+        n2, _ = rv.get_account(ADDRS[1])
+        assert n2 == best
+
+    def test_retire_respects_newer_entries(self, chain_bc):
+        rv = ReadView(chain_bc)
+        a = Account(nonce=1, balance=10)
+        b = Account(nonce=2, balance=20)
+        rv.publish_block(self._header(100), {ADDRS[0]: a})
+        rv.publish_block(self._header(101), {ADDRS[0]: b})
+        rv.retire_through(100)  # block 101's entry must survive
+        _, acc = rv.get_account(ADDRS[0])
+        assert acc.nonce == 2
+        rv.retire_through(101)
+        assert rv.snapshot()["overlayAddrs"] == 0
+
+    def test_invalidate_rolls_back_to_durable(self, chain_bc):
+        rv = ReadView(chain_bc)
+        best = chain_bc.best_block_number
+        rv.publish_block(self._header(best + 1),
+                         {ADDRS[0]: Account(nonce=9)})
+        rv.publish_block(self._header(best + 2),
+                         {ADDRS[0]: Account(nonce=10)})
+        rv.invalidate_above(best + 1)
+        _, acc = rv.get_account(ADDRS[0])
+        assert acc.nonce == 9  # block best+1 survived the abort
+        rv.invalidate_above(best)
+        n, acc = rv.get_account(ADDRS[0])
+        assert n == best  # back to the committed store entirely
+        assert rv.snapshot()["invalidated"] == 2
+
+    def test_deletion_reads_as_absent_not_store_fallthrough(
+        self, chain_bc
+    ):
+        rv = ReadView(chain_bc)
+        best = chain_bc.best_block_number
+        rv.publish_block(self._header(best + 1), {ADDRS[0]: None})
+        _, acc = rv.get_account(ADDRS[0])
+        assert acc is None  # deleted in-overlay, NOT the store account
+
+
+# -------------------------------------------------------------- slo
+
+
+class TestSlo:
+    def test_quantile_interpolates_and_floors(self):
+        hist = {"count": 100, "sum": 1.0,
+                "buckets": {0.001: 50, 0.01: 100, float("inf"): 100}}
+        assert quantile(hist, 0.25) == pytest.approx(0.0005)
+        assert quantile(hist, 0.75) == pytest.approx(0.0055)
+        assert quantile({"count": 0, "sum": 0, "buckets": {}}, 0.99) == 0
+        tail = {"count": 10, "sum": 60.0,
+                "buckets": {**{b: 0 for b in LATENCY_BUCKETS},
+                            float("inf"): 10}}
+        # all observations beyond the last bound: floored, not inf
+        assert quantile(tail, 0.99) == LATENCY_BUCKETS[-1]
+
+    def _tracker(self):
+        # fresh registry: instruments are process-global truth keyed by
+        # (family, labels); an isolated tracker needs its own
+        from khipu_tpu.observability.registry import MetricsRegistry
+
+        return SloTracker(registry=MetricsRegistry())
+
+    def test_shed_is_counted_not_timed(self):
+        slo = self._tracker()
+        slo.observe("eth_call", 0.004, "ok")
+        slo.observe("eth_call", 0.0, "shed")
+        ev = slo.evaluate()
+        m = ev["methods"]["eth_call"]
+        assert m["count"] == 1  # the shed never entered the histogram
+        assert m["shed"] == 1
+        assert m["class"] == "execute"
+        assert m["withinSlo"] is True
+
+    def test_error_budget_accounting(self):
+        slo = self._tracker()
+        for _ in range(99):
+            slo.observe("eth_getBalance", 0.001, "ok")
+        slo.observe("eth_getBalance", 0.001, "error")
+        budget = slo.evaluate()["errorBudget"]
+        assert budget["requests"] == 100
+        assert budget["bad"] == 1
+        assert budget["badFraction"] == pytest.approx(0.01)
+
+
+class TestServingPlane:
+    def test_admit_finish_and_shed_recording(self):
+        from khipu_tpu.observability.registry import MetricsRegistry
+
+        pressure = {"v": 0.0}
+        # fresh registry: instruments are process-global truth keyed
+        # by (family, labels), so an isolated tracker needs its own
+        plane = ServingPlane(
+            ServingConfig(),
+            admission=AdmissionController(
+                ServingConfig(), signals=[lambda: pressure["v"]],
+                registry=MetricsRegistry(),
+            ),
+            slo=SloTracker(registry=MetricsRegistry()),
+        )
+        ticket = plane.admit("eth_getBalance")
+        plane.finish("eth_getBalance", ticket)
+        pressure["v"] = 1.0
+        with pytest.raises(ServerBusy):
+            plane.admit("eth_sendRawTransaction")
+        ev = plane.slo.evaluate()["methods"]
+        assert ev["eth_getBalance"]["count"] == 1
+        assert ev["eth_sendRawTransaction"]["shed"] == 1
+
+
+# ------------------------------------------------- rpc surface caps
+
+
+class TestServerCaps:
+    def _server(self, **kw):
+        bc = _fresh()
+        service = EthService(bc, CFG, PendingTransactionsPool())
+        return JsonRpcServer(service, **kw)
+
+    def test_batch_cap(self):
+        server = self._server(max_batch=3)
+        req = {"jsonrpc": "2.0", "id": 1, "method": "eth_blockNumber",
+               "params": []}
+        assert isinstance(server.handle([req] * 3), list)
+        out = server.handle([req] * 4)
+        assert out["error"]["code"] == -32600
+        assert "batch too large" in out["error"]["message"]
+
+    def test_serving_config_overrides_caps(self):
+        bc = _fresh()
+        plane = ServingPlane(ServingConfig(max_batch=7,
+                                           max_body_bytes=1234))
+        server = JsonRpcServer(
+            EthService(bc, CFG, PendingTransactionsPool()),
+            serving=plane, max_batch=999,
+        )
+        assert server.max_batch == 7
+        assert server.max_body_bytes == 1234
+
+    def test_unknown_method_bypasses_admission(self):
+        bc = _fresh()
+        calls = []
+
+        class SpyPlane(ServingPlane):
+            def admit(self, method):
+                calls.append(method)
+                return super().admit(method)
+
+        server = JsonRpcServer(
+            EthService(bc, CFG, PendingTransactionsPool()),
+            serving=SpyPlane(ServingConfig()),
+        )
+        out = server.handle({"jsonrpc": "2.0", "id": 1,
+                             "method": "eth_noSuchThing", "params": []})
+        assert out["error"]["code"] == -32601
+        assert calls == []  # -32601 consumed no admission slot
+
+    def test_body_cap_over_http(self):
+        server = self._server(max_body_bytes=2048)
+        port = server.start()
+        try:
+            url = f"http://127.0.0.1:{port}"
+            ok = json.loads(
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        url,
+                        data=json.dumps(
+                            {"jsonrpc": "2.0", "id": 1,
+                             "method": "eth_blockNumber",
+                             "params": []}
+                        ).encode(),
+                        headers={"Content-Type": "application/json"},
+                    ),
+                    timeout=10,
+                ).read()
+            )
+            assert ok["result"] == "0x0"
+            big = json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": "eth_blockNumber",
+                 "params": ["x" * 4096]}
+            ).encode()
+            resp = json.loads(
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        url, data=big,
+                        headers={"Content-Type": "application/json"},
+                    ),
+                    timeout=10,
+                ).read()
+            )
+            assert resp["error"]["code"] == -32600
+            assert "body too large" in resp["error"]["message"]
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------ filter TTL
+
+
+class TestFilterTtl:
+    def _mgr(self, chain_bc, ttl=300.0):
+        mgr = FilterManager(chain_bc, ttl=ttl)
+        clock = {"t": 1000.0}
+        mgr._now = lambda: clock["t"]
+        return mgr, clock
+
+    def test_unpolled_filter_expires(self, chain_bc):
+        mgr, clock = self._mgr(chain_bc)
+        fid = mgr.new_block_filter()
+        clock["t"] += 301.0
+        # installing another filter sweeps; the stale one is evicted
+        mgr.new_block_filter()
+        assert mgr.changes(fid) is None  # geth: "filter not found"
+        snap = mgr.snapshot()
+        assert snap["evictions"] == 1
+        assert snap["active"] == 1
+
+    def test_polling_keeps_a_filter_alive(self, chain_bc):
+        mgr, clock = self._mgr(chain_bc)
+        fid = mgr.new_log_filter(LogQuery(0, None))
+        for _ in range(4):
+            clock["t"] += 200.0  # each poll resets the TTL window
+            assert mgr.changes(fid) is not None
+        assert mgr.snapshot()["evictions"] == 0
+
+    def test_uninstall_is_not_an_eviction(self, chain_bc):
+        mgr, clock = self._mgr(chain_bc)
+        fid = mgr.new_block_filter()
+        assert mgr.uninstall(fid) is True
+        assert mgr.snapshot()["evictions"] == 0
+
+
+# ------------------------------------------------- txpool semantics
+
+
+class TestTxPoolReplacement:
+    def test_higher_gas_price_replaces(self):
+        pool = PendingTransactionsPool()
+        low = _tx(KEYS[0], 0, ADDRS[1], 1, gas_price=10**9)
+        high = _tx(KEYS[0], 0, ADDRS[1], 1, gas_price=2 * 10**9)
+        assert pool.add(low)
+        assert pool.add(high)
+        assert len(pool) == 1
+        assert pool.get(low.hash) is None
+        assert pool.get(high.hash) is not None
+        assert pool.replacements == 1
+
+    def test_equal_or_lower_price_rejected(self):
+        pool = PendingTransactionsPool()
+        a = _tx(KEYS[0], 0, ADDRS[1], 1, gas_price=10**9)
+        b = _tx(KEYS[0], 0, ADDRS[2], 2, gas_price=10**9)  # same slot
+        assert pool.add(a)
+        assert not pool.add(b)
+        assert pool.rejected_underpriced == 1
+        assert pool.get(a.hash) is not None
+
+    def test_distinct_nonces_do_not_interact(self):
+        pool = PendingTransactionsPool()
+        assert pool.add(_tx(KEYS[0], 0, ADDRS[1], 1))
+        assert pool.add(_tx(KEYS[0], 1, ADDRS[1], 1))
+        assert len(pool) == 2
+        assert pool.replacements == 0
+
+    def test_eviction_frees_the_slot_index(self):
+        pool = PendingTransactionsPool(capacity=2)
+        t0 = _tx(KEYS[0], 0, ADDRS[1], 1)
+        pool.add(t0)
+        pool.add(_tx(KEYS[0], 1, ADDRS[1], 1))
+        pool.add(_tx(KEYS[0], 2, ADDRS[1], 1))  # evicts t0
+        assert pool.evictions == 1
+        assert pool.get(t0.hash) is None
+        # the evicted slot is free again: a fresh nonce-0 tx is NEW,
+        # not an underpriced replacement of a ghost
+        assert pool.add(_tx(KEYS[0], 0, ADDRS[1], 2))
+        assert pool.rejected_underpriced == 0
+
+    def test_remove_mined_frees_the_slot_index(self):
+        pool = PendingTransactionsPool()
+        t0 = _tx(KEYS[0], 0, ADDRS[1], 1)
+        pool.add(t0)
+        assert pool.remove_mined([t0]) == 1
+        assert pool.add(_tx(KEYS[0], 0, ADDRS[1], 2))
+
+    def test_gauges_in_exposition(self):
+        from khipu_tpu.observability.registry import REGISTRY
+
+        PendingTransactionsPool()
+        text = REGISTRY.prometheus_text()
+        for family in ("khipu_txpool_size", "khipu_txpool_capacity",
+                       "khipu_txpool_replacements_total"):
+            assert f"# TYPE {family} " in text
+
+
+class TestSendRawTransactionParity:
+    def _service(self):
+        bc = _fresh()
+        pool = PendingTransactionsPool()
+        return EthService(bc, CFG, pool), pool
+
+    def _raw(self, stx):
+        return "0x" + stx.encode().hex()
+
+    def test_duplicate_is_already_known(self):
+        service, _ = self._service()
+        stx = _tx(KEYS[0], 0, ADDRS[1], 1)
+        service.eth_sendRawTransaction(self._raw(stx))
+        from khipu_tpu.jsonrpc.eth_service import RpcError
+
+        with pytest.raises(RpcError, match="already known") as e:
+            service.eth_sendRawTransaction(self._raw(stx))
+        assert e.value.code == -32000
+
+    def test_underpriced_replacement_is_named(self):
+        service, _ = self._service()
+        service.eth_sendRawTransaction(
+            self._raw(_tx(KEYS[0], 0, ADDRS[1], 1, gas_price=10**9))
+        )
+        from khipu_tpu.jsonrpc.eth_service import RpcError
+
+        with pytest.raises(
+            RpcError, match="replacement transaction underpriced"
+        ):
+            service.eth_sendRawTransaction(
+                self._raw(_tx(KEYS[0], 0, ADDRS[2], 2,
+                              gas_price=10**9))
+            )
+
+    def test_outbidding_replacement_is_accepted(self):
+        service, pool = self._service()
+        service.eth_sendRawTransaction(
+            self._raw(_tx(KEYS[0], 0, ADDRS[1], 1, gas_price=10**9))
+        )
+        h = service.eth_sendRawTransaction(
+            self._raw(_tx(KEYS[0], 0, ADDRS[1], 1,
+                          gas_price=3 * 10**9))
+        )
+        assert len(pool) == 1
+        assert pool.get(bytes.fromhex(h[2:])) is not None
+
+    def test_empty_pool_argument_is_kept(self):
+        """Regression: `tx_pool or ...` swapped an EMPTY caller pool
+        (falsy: __len__ == 0) for a private one, so the node's pool
+        and the RPC pool silently diverged."""
+        pool = PendingTransactionsPool()
+        service = EthService(_fresh(), CFG, pool)
+        assert service.tx_pool is pool
+
+
+# ------------------------------------------------------ rpc + view
+
+
+class TestReadYourWritesOverRpc:
+    def test_latest_reads_resolve_through_the_view(self, chain_bc):
+        rv = ReadView(chain_bc)
+        service = EthService(chain_bc, CFG, PendingTransactionsPool(),
+                             read_view=rv)
+        best = chain_bc.best_block_number
+        bal0 = int(service.eth_getBalance("0x" + MINER.hex(),
+                                          "latest"), 16)
+        nonce0 = int(service.eth_getTransactionCount(
+            "0x" + ADDRS[0].hex(), "latest"), 16)
+
+        class H:
+            number = best + 1
+
+        rv.publish_block(H(), {
+            MINER: Account(balance=bal0 + 7),
+            ADDRS[0]: Account(nonce=nonce0 + 1, balance=1),
+        })
+        assert int(service.eth_blockNumber(), 16) == best + 1
+        assert int(service.eth_getBalance("0x" + MINER.hex(),
+                                          "latest"), 16) == bal0 + 7
+        assert int(service.eth_getTransactionCount(
+            "0x" + ADDRS[0].hex(), "latest"), 16) == nonce0 + 1
+        # historical tags still read the committed store
+        assert int(service.eth_getBalance("0x" + MINER.hex(),
+                                          hex(best)), 16) == bal0
+
+    def test_metrics_embed_serving_snapshot(self, chain_bc):
+        rv = ReadView(chain_bc)
+        plane = ServingPlane(ServingConfig(), read_view=rv)
+        service = EthService(chain_bc, CFG, PendingTransactionsPool(),
+                             read_view=rv, serving=plane)
+        out = service.khipu_metrics()
+        assert "admission" in out["serving"]
+        assert "slo" in out["serving"]
+        assert out["serving"]["readView"]["head"] >= 0
+        assert "filters" in out
+
+
+# ---------------------------------------------------------- loadgen
+
+
+class _StubTransport:
+    """Scripted responses; records every call."""
+
+    def __init__(self, responder):
+        self.responder = responder
+        self.calls = []
+
+    def call(self, method, params):
+        self.calls.append((method, params))
+        return self.responder(method, params)
+
+
+class TestLoadgen:
+    def test_same_seed_same_request_stream(self):
+        def run():
+            t = _StubTransport(lambda m, p: {"jsonrpc": "2.0", "id": 1,
+                                             "result": "0x0"})
+            LoadGenerator(t, READ_ONLY, clients=2, max_requests=30,
+                          seed=77,
+                          nonce_addresses=["0x" + ADDRS[0].hex()],
+                          balance_addresses=["0x" + MINER.hex()],
+                          ).run()
+            return t.calls
+
+        assert run() == run()
+
+    def test_nonce_regression_is_a_violation(self):
+        answers = iter(["0x5", "0x4"])  # nonce goes BACKWARDS
+
+        def responder(method, params):
+            if method == "eth_getTransactionCount":
+                return {"jsonrpc": "2.0", "id": 1,
+                        "result": next(answers, "0x4")}
+            return {"jsonrpc": "2.0", "id": 1, "result": "0x0"}
+
+        profile = WorkloadProfile("nonce_only",
+                                  {"eth_getTransactionCount": 1.0})
+        report = LoadGenerator(
+            _StubTransport(responder), profile, clients=1,
+            max_requests=2, seed=1,
+            nonce_addresses=["0x" + ADDRS[0].hex()],
+        ).run()
+        assert len(report.violations) == 1
+        assert "regressed" in report.violations[0].detail
+
+    def test_shed_responses_counted_not_timed(self):
+        def responder(method, params):
+            return {"jsonrpc": "2.0", "id": 1,
+                    "error": {"code": -32005, "message": "busy"}}
+
+        report = LoadGenerator(
+            _StubTransport(responder), READ_ONLY, clients=1,
+            max_requests=10, seed=3,
+        ).run()
+        assert report.shed == 10
+        assert report.errors == 0
+        assert report.latencies == {}  # sheds never enter percentiles
+
+    def test_invisible_own_tx_is_a_violation(self):
+        def responder(method, params):
+            if method == "eth_getTransactionByHash":
+                return {"jsonrpc": "2.0", "id": 1, "result": None}
+            return {"jsonrpc": "2.0", "id": 1, "result": "0x" + "ab" * 32}
+
+        profile = WorkloadProfile("writes",
+                                  {"eth_sendRawTransaction": 1.0})
+        report = LoadGenerator(
+            _StubTransport(responder), profile, clients=1,
+            max_requests=1, seed=4,
+            balance_addresses=["0x" + MINER.hex()],
+        ).run()
+        assert len(report.violations) == 1
+        assert "invisible" in report.violations[0].detail
+
+
+# ----------------------------------------------- heavy load (serve)
+
+
+def _serving_stack():
+    from khipu_tpu.observability.registry import MetricsRegistry
+
+    bc = _fresh()
+    pool = PendingTransactionsPool()
+    rv = ReadView(bc)
+    plane = ServingPlane(
+        ServingConfig(),
+        read_view=rv,
+        admission=AdmissionController(ServingConfig(),
+                                      signals=[txpool_pressure(pool)],
+                                      registry=MetricsRegistry()),
+        slo=SloTracker(registry=MetricsRegistry()),
+    )
+    service = EthService(bc, CFG, pool, read_view=rv, serving=plane)
+    return JsonRpcServer(service, serving=plane), plane
+
+
+@pytest.mark.serve
+@pytest.mark.slow
+class TestHeavyLoad:
+    def test_in_process_mixed_load_clean(self):
+        server, plane = _serving_stack()
+        report = LoadGenerator(
+            InProcessTransport(server), MIXED, clients=8,
+            max_requests=250, seed=42,
+            nonce_addresses=["0x" + a.hex() for a in ADDRS],
+            balance_addresses=["0x" + MINER.hex()],
+            chain_id=1,
+        ).run()
+        assert report.requests == 2000
+        assert report.violations == []
+        assert report.errors == 0
+        ev = plane.slo.evaluate()
+        assert ev["errorBudget"]["bad"] == report.shed
+
+    def test_http_load_clean(self):
+        server, _ = _serving_stack()
+        port = server.start()
+        try:
+            report = LoadGenerator(
+                HttpTransport(f"http://127.0.0.1:{port}"), READ_ONLY,
+                clients=4, max_requests=50, seed=43,
+                nonce_addresses=["0x" + a.hex() for a in ADDRS],
+                balance_addresses=["0x" + MINER.hex()],
+            ).run()
+            assert report.requests == 200
+            assert report.violations == []
+            assert report.errors == 0
+        finally:
+            server.stop()
+
+    def test_open_loop_overload_sheds_not_collapses(self):
+        cfg = ServingConfig(queue_timeout=0.005, max_queue=4)
+        bc = _fresh()
+        pressure = {"v": 0.0}
+        plane = ServingPlane(
+            cfg,
+            admission=AdmissionController(
+                cfg, limits={"read": 2, "cheap": 2},
+                signals=[lambda: pressure["v"]],
+            ),
+        )
+        server = JsonRpcServer(
+            EthService(bc, CFG, PendingTransactionsPool(),
+                       serving=plane),
+            serving=plane,
+        )
+        pressure["v"] = 1.0  # saturated node: reads shed, cheap serves
+        report = LoadGenerator(
+            InProcessTransport(server), READ_ONLY, clients=8,
+            max_requests=100, seed=44,
+            nonce_addresses=["0x" + ADDRS[0].hex()],
+            balance_addresses=["0x" + MINER.hex()],
+        ).run()
+        assert report.shed > 0
+        assert report.violations == []
