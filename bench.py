@@ -32,6 +32,11 @@ import sys
 import time
 
 
+# every emitted line, in order — the --compare gate diffs these against
+# a captured baseline without re-parsing our own stdout
+_EMITTED = []
+
+
 def emit(metric, value, unit, vs_baseline=0.0, **extra):
     line = {
         "metric": metric,
@@ -40,6 +45,7 @@ def emit(metric, value, unit, vs_baseline=0.0, **extra):
         "vs_baseline": vs_baseline,
     }
     line.update(extra)
+    _EMITTED.append(line)
     print(json.dumps(line), flush=True)
 
 
@@ -110,11 +116,13 @@ def _replay_fixture(parallel, window, alloc, build_blocks, device_commit,
     target = Blockchain(Storages(), cfg)
     target.load_genesis(GenesisSpec(alloc=alloc))
     if trace:
-        # drop chain-build/warm-up spans: the breakdown must cover
-        # exactly the timed replay below
+        # drop chain-build/warm-up spans AND transfer events: the
+        # breakdown must cover exactly the timed replay below
+        from khipu_tpu.observability.profiler import LEDGER
         from khipu_tpu.observability.trace import tracer
 
         tracer.reset()
+        LEDGER.reset()
     driver = ReplayDriver(target, cfg, device_commit=device_commit)
     return driver.replay(blocks)
 
@@ -127,12 +135,25 @@ def _trace_report(stats):
     must land within a few percent of stats.seconds; the smoke test
     asserts exactly that."""
     from khipu_tpu.observability import recorder
+    from khipu_tpu.observability.profiler import LEDGER
     from khipu_tpu.observability.registry import REGISTRY
     from khipu_tpu.observability.trace import tracer
 
     spans = tracer.snapshot()
     breakdown = recorder.phase_breakdown(spans)
     log = recorder.compile_log.snapshot()
+    # data-movement ledger: which bytes crossed the host<->device
+    # boundary, per pipeline phase, normalized per block — the
+    # companion number to the collect-share split (BENCH_r05 showed
+    # collect dominating; this says WHICH bytes it moved)
+    movement = {}
+    if LEDGER.enabled and LEDGER.blocks:
+        movement = {
+            "bytes_per_block_by_phase": LEDGER.phase_bytes_per_block(),
+            "device_bytes_total": LEDGER.direction_totals(),
+            "ledger_blocks": LEDGER.blocks,
+            "transfer_events": LEDGER.recorded,
+        }
     return {
         "phase_seconds": breakdown,
         "driver_total_s": round(
@@ -156,6 +177,7 @@ def _trace_report(stats):
             for k, h in recorder.PHASE_HISTOGRAMS.items()
             if h.value["count"]
         },
+        **({"movement": movement} if movement else {}),
     }
 
 
@@ -167,9 +189,11 @@ def run_traced_replay(n_blocks=32, txs_per_block=50, window=4,
     --trace CLI wraps this with device_commit=True; the smoke test
     calls it with a tiny chain and device_commit=False (host hasher —
     no multi-second XLA compile inside a 'not slow' test)."""
+    from khipu_tpu.observability.profiler import LEDGER
     from khipu_tpu.observability.trace import tracer
 
     tracer.enable()
+    LEDGER.enable()
     try:
         stats = _bench_replay_stats(
             n_blocks, txs_per_block, parallel=True, window=window,
@@ -184,6 +208,7 @@ def run_traced_replay(n_blocks=32, txs_per_block=50, window=4,
             report["chrome_trace"] = chrome_out
     finally:
         tracer.disable()
+        LEDGER.disable()
     return stats, report
 
 
@@ -848,6 +873,179 @@ def bench_replay_chaos(seed=0, n_blocks=32, txs_per_block=50, window=4,
     )
 
 
+# ---------------------------------------------------------- regression gate
+
+
+DEFAULT_COMPARE_THRESHOLDS = {
+    # blocks/s may regress to this fraction of the baseline before the
+    # gate trips — generous, because shared-CI hardware variance on the
+    # fixture replays is real (BENCH captures come from whatever box ran
+    # the driver); a true regression from a code change shows up as a
+    # structural drop, not noise
+    "min_blocks_per_s_ratio": 0.5,
+    # collect's share of driver wall clock may grow this much, absolute
+    "max_collect_share_delta": 0.15,
+    # device bytes/block may grow to this multiple of the baseline —
+    # skipped when the baseline predates the ledger and has no movement
+    # numbers (BENCH_r05 does not)
+    "max_bytes_per_block_ratio": 1.25,
+}
+
+
+def parse_baseline(path):
+    """A BENCH-style capture: {"tail": "<one JSON line per metric>",
+    "parsed": <last line>, ...}. metric -> line dict. Tolerates
+    malformed lines — BENCH_r05.json's first tail line is truncated
+    mid-token by the capture's byte budget, and a gate that crashes on
+    its own baseline gates nothing."""
+    with open(path) as f:
+        doc = json.load(f)
+    out = {}
+    for raw in doc.get("tail", "").splitlines():
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+        except ValueError:
+            continue
+        if isinstance(line, dict) and "metric" in line:
+            out[line["metric"]] = line
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        out.setdefault(parsed["metric"], parsed)
+    return out
+
+
+def _collect_share(line):
+    """collect / (sum of driver-thread phases). The _bg phases overlap
+    driver work on the background thread — counting them would dilute
+    the share the baseline reported."""
+    phases = line.get("phases")
+    if not isinstance(phases, dict):
+        return None
+    total = sum(
+        v for k, v in phases.items()
+        if isinstance(v, (int, float)) and not k.endswith("_bg")
+    )
+    if total <= 0:
+        return None
+    return phases.get("collect", 0.0) / total
+
+
+def _baseline_bytes_per_block(line):
+    m = line.get("movement")
+    if isinstance(m, dict):
+        tot = m.get("device_bytes_total")
+        blocks = m.get("ledger_blocks")
+        if isinstance(tot, dict) and blocks:
+            return sum(tot.values()) / blocks
+    return None
+
+
+def _compare_line(line, base, bytes_per_block, th):
+    metric = line["metric"]
+    out = {"metric": metric, "failures": []}
+    if bytes_per_block is not None:
+        out["bytes_per_block"] = round(bytes_per_block)
+    if base is None:
+        out["note"] = "no baseline entry (skipped)"
+        return out
+    if line.get("unit") == "blocks/s" and base.get("value"):
+        ratio = line["value"] / base["value"]
+        out["blocks_per_s"] = line["value"]
+        out["baseline_blocks_per_s"] = base["value"]
+        out["ratio"] = round(ratio, 3)
+        if ratio < th["min_blocks_per_s_ratio"]:
+            out["failures"].append(
+                f"{metric}: blocks/s ratio {ratio:.3f} < "
+                f"{th['min_blocks_per_s_ratio']} "
+                f"({line['value']} vs baseline {base['value']})"
+            )
+    share_now = _collect_share(line)
+    share_base = _collect_share(base)
+    if share_now is not None and share_base is not None:
+        out["collect_share"] = round(share_now, 4)
+        out["baseline_collect_share"] = round(share_base, 4)
+        if share_now - share_base > th["max_collect_share_delta"]:
+            out["failures"].append(
+                f"{metric}: collect share grew "
+                f"{share_base:.3f} -> {share_now:.3f} "
+                f"(> +{th['max_collect_share_delta']})"
+            )
+    base_bpb = _baseline_bytes_per_block(base)
+    if bytes_per_block is not None and base_bpb:
+        r = bytes_per_block / base_bpb
+        out["bytes_per_block_ratio"] = round(r, 3)
+        if r > th["max_bytes_per_block_ratio"]:
+            out["failures"].append(
+                f"{metric}: device bytes/block grew {r:.2f}x "
+                f"(> {th['max_bytes_per_block_ratio']}x)"
+            )
+    return out
+
+
+def bench_compare(path, thresholds=None, runners=None):
+    """``bench.py --compare=BASELINE.json``: re-run the headline replay
+    configs with the TransferLedger on, diff blocks/s, collect share,
+    and device bytes/block against the captured baseline, and return
+    non-zero past the thresholds — the bench regression gate
+    (scripts/bench_gate.sh wraps this next to tier-1). The emitted
+    ``bench_compare`` line carries the movement metrics a FUTURE
+    baseline capture needs for the bytes/block comparison."""
+    from khipu_tpu.observability.profiler import LEDGER
+
+    th = dict(DEFAULT_COMPARE_THRESHOLDS)
+    th.update(thresholds or {})
+    base = parse_baseline(path)
+    if runners is None:
+        runners = [
+            lambda: bench_replay(
+                32, 50, "replay_parallel_commit_fixture_blocks_per_sec",
+                parallel=True, window=8,
+            ),
+            bench_replay_contended,
+        ]
+    failures = []
+    comparisons = []
+    LEDGER.enable()
+    try:
+        for run in runners:
+            LEDGER.reset()  # per-config movement numbers
+            mark = len(_EMITTED)
+            run()
+            bpb = None
+            movement = {}
+            if LEDGER.blocks:
+                tot = LEDGER.direction_totals()
+                bpb = sum(tot.values()) / LEDGER.blocks
+                movement = {
+                    "device_bytes_total": tot,
+                    "ledger_blocks": LEDGER.blocks,
+                    "bytes_per_block_by_phase":
+                        LEDGER.phase_bytes_per_block(),
+                }
+            for line in _EMITTED[mark:]:
+                cmp = _compare_line(line, base.get(line["metric"]),
+                                    bpb, th)
+                if movement:
+                    cmp["movement"] = movement
+                comparisons.append(cmp)
+                failures.extend(cmp["failures"])
+    finally:
+        LEDGER.disable()
+    emit(
+        "bench_compare",
+        len(failures),
+        "failures",
+        baseline=path,
+        thresholds=th,
+        comparisons=comparisons,
+        **({"failed": failures} if failures else {}),
+    )
+    return 1 if failures else 0
+
+
 def _serve_setup(n_blocks, txs_per_block, window=2, depth=2):
     """Fixture chain + fresh target + serving plane wired the way
     ServiceBoard.start_serving does it, but with bench-scaled admission
@@ -1039,11 +1237,29 @@ def bench_serve(smoke=False):
         resp = transport.call("eth_sendRawTransaction", ["0x00"])
         assert resp.get("error", {}).get("code") == -32005, resp
         plane.admission.signals.pop()
+        # exercise one ledger crossing so the lazily-registered
+        # transfer families exist, then pin them to exactly one TYPE
+        # line each alongside the serving families
+        from khipu_tpu.observability.profiler import H2D, LEDGER
+
+        was_on = LEDGER.enabled
+        LEDGER.enable()
+        LEDGER.record("bench.smoke", H2D, 1)
+        if not was_on:
+            LEDGER.disable()
         text = service.khipu_metrics_text()
         lat = text.count("# TYPE khipu_rpc_latency_seconds histogram")
         shed = text.count("# TYPE khipu_rpc_shed_total counter")
+        tb = text.count(
+            "# TYPE khipu_device_transfer_bytes_total counter"
+        )
+        ts = text.count(
+            "# TYPE khipu_device_transfer_seconds_total counter"
+        )
         assert lat == 1, f"latency histogram TYPE lines: {lat}"
         assert shed == 1, f"shed counter TYPE lines: {shed}"
+        assert tb == 1, f"transfer bytes TYPE lines: {tb}"
+        assert ts == 1, f"transfer seconds TYPE lines: {ts}"
         assert violations == 0, (
             mixed.violations + overload.violations
         )
@@ -1052,6 +1268,7 @@ def bench_serve(smoke=False):
             "requests",
             violations=violations,
             exposition_families_ok=True,
+            transfer_families_ok=True,
             slo_methods=len(plane.slo.evaluate()["methods"]),
         )
         return
@@ -1109,6 +1326,25 @@ def main() -> None:
     if "--serve" in sys.argv:
         bench_serve(smoke="--smoke" in sys.argv)
         return
+    compare_path = None
+    thresholds = {}
+    for arg in sys.argv[1:]:
+        if arg.startswith("--compare="):
+            compare_path = arg.split("=", 1)[1]
+        elif arg.startswith("--min-blocks-ratio="):
+            thresholds["min_blocks_per_s_ratio"] = float(
+                arg.split("=", 1)[1]
+            )
+        elif arg.startswith("--max-collect-delta="):
+            thresholds["max_collect_share_delta"] = float(
+                arg.split("=", 1)[1]
+            )
+        elif arg.startswith("--max-bytes-ratio="):
+            thresholds["max_bytes_per_block_ratio"] = float(
+                arg.split("=", 1)[1]
+            )
+    if compare_path is not None:
+        sys.exit(bench_compare(compare_path, thresholds=thresholds))
     for arg in sys.argv[1:]:
         if arg.startswith("--chaos"):
             seed = int(arg.split("=", 1)[1]) if "=" in arg else 0

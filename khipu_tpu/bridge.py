@@ -64,8 +64,10 @@ from khipu_tpu.observability.trace import (
 SERVICE = "khipu.Bridge"
 
 # gRPC metadata keys the client attaches on EVERY call (values are the
-# caller's tracer identity; khipu-sampled="0" still ships the keys so
-# the wire format is unconditional and greppable)
+# caller's tracer identity; the keys ship unconditionally so the wire
+# format stays greppable — khipu-sampled is "1" (record+link), "0"
+# (head sampler dropped this trace id; server skips its serve span
+# too), or "" (tracing off on the caller, no decision))
 MD_TRACE_ID = "khipu-trace-id"
 MD_PARENT_TOKEN = "khipu-parent-token"
 MD_SAMPLED = "khipu-sampled"
@@ -257,7 +259,14 @@ class BridgeServer:
                 # — the token lives in the CALLER's id space)
                 tags = {"method": name}
                 md = dict(context.invocation_metadata() or ())
-                if md.get(MD_SAMPLED) == "1":
+                sampled = md.get(MD_SAMPLED)
+                if sampled == "0":
+                    # the caller made the head-based per-trace-id drop
+                    # decision (trace.trace_sampled) — honor it so one
+                    # trace is whole or absent FLEET-wide: no server
+                    # span, no orphan fragments in the shard's ring
+                    return fn(request, context)
+                if sampled == "1":
                     tags["remote_trace"] = md.get(MD_TRACE_ID, "")
                     tok = md.get(MD_PARENT_TOKEN, "")
                     if tok.isdigit():
@@ -331,7 +340,17 @@ class BridgeClient:
             md = (
                 (MD_TRACE_ID, t.trace_id),
                 (MD_PARENT_TOKEN, str(sp.token or "")),
-                (MD_SAMPLED, "1" if t.enabled else "0"),
+                # three-valued: "1" = record+link, "0" = the head
+                # sampler DROPPED this trace id (tracer on, trace
+                # out — the server must skip too so the trace is
+                # whole or absent fleet-wide), "" = tracing is off
+                # here, no decision made (the server keeps its own
+                # local, unlinked serve span)
+                (
+                    MD_SAMPLED,
+                    "1" if t.enabled
+                    else ("0" if getattr(t, "_on", False) else ""),
+                ),
             )
             return fn(payload, timeout=self.deadline, metadata=md)
 

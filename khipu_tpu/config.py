@@ -145,6 +145,17 @@ class ObservabilityConfig:
 
     enabled: bool = False
     ring_capacity: int = 65536  # spans retained (drop-oldest beyond)
+    # head-based per-trace-id sampling: keep N in 10_000 traces, decided
+    # deterministically from the trace id (trace.trace_sampled) so every
+    # process keeps or drops the SAME traces — lets tracing stay on
+    # under real traffic. 10_000 (default) keeps everything
+    sample_per_10k: int = 10_000
+    # data-movement ledger (observability/profiler.py): per-site
+    # host<->device transfer accounting behind khipu_device_transfer_*
+    # and khipu_window_report(n). Off by default — same zero-cost
+    # contract as the tracer
+    ledger_enabled: bool = False
+    ledger_capacity: int = 65536  # transfer events retained
     # fused ext-tile signature cache bound (trie/fused.py): compiled
     # fixpoint programs retained before LRU eviction; evictions/misses
     # are counted in the compile-event log

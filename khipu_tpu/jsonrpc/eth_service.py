@@ -645,6 +645,19 @@ class EthService:
         n = parse_qty(number) if isinstance(number, str) else int(number)
         return export.trace_block(n, tracer_=self.tracer)
 
+    def khipu_window_report(self, number) -> dict:
+        """Data-movement record of the window containing block ``n``:
+        phase x bytes x site from the TransferLedger (which bytes
+        crossed the host↔device boundary, from which call site, during
+        which pipeline phase), collect traffic classified into
+        placeholder-resolution vs store-write vs block-save, merged
+        with the span-derived phase wall seconds when the ring still
+        holds the window's spans."""
+        from khipu_tpu.observability import recorder
+
+        n = parse_qty(number) if isinstance(number, str) else int(number)
+        return recorder.window_report(n, self.tracer.snapshot())
+
     def khipu_dump_chrome_trace(self, path: str) -> dict:
         """Write the ring's spans as Chrome trace_event JSON (load in
         perfetto / chrome://tracing); returns {path, spans, shards}.

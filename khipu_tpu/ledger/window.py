@@ -19,6 +19,7 @@ during execution), so windows > 1 require Byzantium+ receipt semantics
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -26,6 +27,7 @@ from khipu_tpu.base.crypto.keccak import keccak256
 from khipu_tpu.domain.account import address_key
 from khipu_tpu.domain.block_header import BlockHeader
 from khipu_tpu.ledger.world import BlockWorldState
+from khipu_tpu.observability.profiler import HOST, LEDGER
 from khipu_tpu.observability.trace import event, span
 from khipu_tpu.trie.bulk import Hasher, host_hasher
 from khipu_tpu.trie.deferred import (
@@ -523,8 +525,17 @@ class WindowCommitter:
                     storage_nodes[real] = enc
                 else:
                     account_nodes[real] = enc
+            t_store = time.perf_counter()
             self.storages.account_node_storage.update([], account_nodes)
             self.storages.storage_node_storage.update([], storage_nodes)
+            if LEDGER.enabled:
+                # host-side store traffic: classification only (HOST
+                # direction never feeds the device-transfer counters)
+                LEDGER.record(
+                    "window.store", HOST,
+                    sum(len(e) for e in subbed) + 32 * len(live_phs),
+                    duration=time.perf_counter() - t_store,
+                )
         # only THIS window's codes persist (later windows' roots are
         # still unchecked; their codes stay staged until their collect)
         staged_codes = self._evmcode_source.staged

@@ -83,10 +83,72 @@ def _us(t_perf: float, t: Tracer) -> float:
     return round((t_perf - t.epoch_perf) * 1e6, 3)
 
 
+def counter_tracks(spans: Optional[Sequence[Span]] = None,
+                   tracer_: Optional[Tracer] = None) -> List[dict]:
+    """Chrome counter ("C") events from the TransferLedger + recorder:
+
+    * ``transfer bytes in flight`` — running sum per direction: +nbytes
+      at each transfer's start, -nbytes at its end, so perfetto shows
+      WHEN the host↔device tunnel was loaded, not just how much total;
+    * ``transfer bytes (cumulative)`` — per-phase cumulative bytes, the
+      area chart that makes "collect moved 10x what seal did" visual;
+    * ``pipeline occupancy`` — the recorder's driver/collector coverage
+      timeline as a counter pair.
+
+    Returns [] when the ledger has no events and no spans were given —
+    an empty trace stays an empty trace.
+    """
+    from khipu_tpu.observability.profiler import LEDGER
+
+    t = tracer_ if tracer_ is not None else tracer
+    events: List[dict] = []
+    transfers = LEDGER.events()
+    if transfers:
+        # bytes-in-flight: merge the +start/-end edges per direction
+        edges: List[tuple] = []
+        cum: dict = {}
+        cum_events: List[tuple] = []
+        for e in transfers:
+            if e.direction == "host":
+                continue  # host persistence is not tunnel traffic
+            edges.append((e.t0, e.direction, e.nbytes))
+            edges.append((e.t0 + e.duration, e.direction, -e.nbytes))
+            phase = e.phase or "untagged"
+            cum[phase] = cum.get(phase, 0) + e.nbytes
+            cum_events.append((e.t0 + e.duration, dict(cum)))
+        in_flight: dict = {}
+        for ts, direction, delta in sorted(edges):
+            in_flight[direction] = in_flight.get(direction, 0) + delta
+            events.append({
+                "name": "transfer bytes in flight", "ph": "C",
+                "pid": 1, "tid": 0, "ts": _us(ts, t),
+                "args": {d: max(0, v) for d, v in in_flight.items()},
+            })
+        for ts, totals in cum_events:
+            events.append({
+                "name": "transfer bytes (cumulative)", "ph": "C",
+                "pid": 1, "tid": 0, "ts": _us(ts, t),
+                "args": totals,
+            })
+    if spans:
+        for row in recorder.occupancy_timeline(spans):
+            events.append({
+                "name": "pipeline occupancy", "ph": "C",
+                "pid": 1, "tid": 0,
+                "ts": round(row["t"] * 1e6, 3),
+                "args": {
+                    "driver": row["driver"],
+                    "collector": row["collector"],
+                },
+            })
+    return events
+
+
 def chrome_trace(spans: Optional[Sequence[Span]] = None,
                  tracer_: Optional[Tracer] = None) -> dict:
     """Chrome ``trace_event`` JSON object format for the given spans
-    (default: the live ring). One process, one track per thread."""
+    (default: the live ring). One process, one track per thread, plus
+    the TransferLedger counter tracks (``counter_tracks``)."""
     t = tracer_ if tracer_ is not None else tracer
     if spans is None:
         spans = t.snapshot()
@@ -134,6 +196,7 @@ def chrome_trace(spans: Optional[Sequence[Span]] = None,
                 "bp": "e", "id": flow_id, "pid": 1, "tid": s.tid,
                 "ts": _us(s.t0, t), "cat": "handoff",
             })
+    events.extend(counter_tracks(spans, tracer_=t))
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",

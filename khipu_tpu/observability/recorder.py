@@ -227,6 +227,41 @@ def occupancy_timeline(
     return out
 
 
+# -------------------------------------------------------- window report
+
+
+def window_report(number: int, spans: Sequence[Span] = ()) -> dict:
+    """One window, broken into phase x bytes x site: the TransferLedger's
+    movement record for the window containing block ``number``, merged
+    with the span-derived phase wall seconds when a snapshot is given.
+    This is what the ``khipu_window_report(n)`` RPC serves — the answer
+    to "WHICH bytes crossed for this window, from which site, during
+    which phase" that BENCH_r05's collect-share number begs for.
+
+    Returns ``{"found": False, ...}`` when the ledger has no window
+    covering ``number`` (ledger disabled, or the window rotated out).
+    """
+    from khipu_tpu.observability.profiler import LEDGER
+
+    rep = LEDGER.window_report(number)
+    if rep is None:
+        return {
+            "found": False,
+            "number": number,
+            "ledgerEnabled": LEDGER.enabled,
+        }
+    out = {"found": True, "number": number, **rep}
+    if spans:
+        lo, hi = rep["block_lo"], rep["block_hi"]
+        window_spans = [
+            s for s in spans
+            if s.tags.get("block_lo") == lo and s.tags.get("block_hi") == hi
+        ]
+        if window_spans:
+            out["phase_wall_seconds"] = phase_breakdown(window_spans)
+    return out
+
+
 # ------------------------------------------------------ nesting checks
 
 

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import threading
 from bisect import bisect_left
+from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
@@ -117,15 +118,28 @@ class Histogram:
 
     def __init__(self, name: str, help: str = "",
                  labels: Optional[Dict[str, str]] = None,
-                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+                 buckets: Optional[Sequence[float]] = None):
         self.name = name
         self.help = help
         self.labels = dict(labels or {})
-        self.buckets = tuple(sorted(buckets))
+        self.buckets = tuple(sorted(
+            buckets if buckets is not None else DEFAULT_BUCKETS
+        ))
         self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+
+    def set_buckets(self, buckets: Sequence[float]) -> bool:
+        """Re-bin to an explicit bucket layout. Only legal while empty:
+        observed samples cannot be re-binned without lying about them.
+        Returns whether the override applied."""
+        with self._lock:
+            if self._count:
+                return False
+            self.buckets = tuple(sorted(buckets))
+            self._counts = [0] * (len(self.buckets) + 1)
+            return True
 
     def observe(self, v: float) -> None:
         i = bisect_left(self.buckets, v)
@@ -213,6 +227,13 @@ class MetricsRegistry:
         # (name, label_key) -> instrument; families group by name
         self._instruments: Dict[Tuple[str, str], object] = {}
         self._collectors: Dict[str, Callable[[], list]] = {}
+        # scrape-pass collector cache (per thread: scrapes are
+        # re-entrant within one exposition call, concurrent across
+        # RPC threads) — see scrape_pass()
+        self._scrape = threading.local()
+        # collector fn invocations, ever — the observable that pins the
+        # one-pull-per-scrape contract (tests + capacity planning)
+        self.collector_pulls = 0
 
     # ------------------------------------------------------- instruments
 
@@ -226,6 +247,13 @@ class MetricsRegistry:
                         f"metric {name!r} re-registered as {cls.kind} "
                         f"(was {inst.kind})"
                     )
+                # per-histogram bucket override on re-register: applies
+                # only while the instrument is empty (set_buckets) —
+                # samples already observed keep their binning
+                buckets = kw.get("buckets")
+                if (buckets is not None and isinstance(inst, Histogram)
+                        and tuple(sorted(buckets)) != inst.buckets):
+                    inst.set_buckets(buckets)
                 return inst
             inst = cls(name, help=help, labels=labels, **kw)
             self._instruments[key] = inst
@@ -241,7 +269,11 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = "",
                   labels: Optional[Dict[str, str]] = None,
-                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        """``buckets=None`` keeps DEFAULT_BUCKETS; an explicit layout
+        overrides — including on re-register, while the histogram is
+        still empty (latency-shaped defaults fit RPC phases but not,
+        e.g., byte-count distributions)."""
         return self._register(
             Histogram, name, help, labels, buckets=buckets
         )
@@ -263,15 +295,44 @@ class MetricsRegistry:
         with self._lock:
             self._collectors.pop(key, None)
 
+    @contextmanager
+    def scrape_pass(self):
+        """One scrape: every pull collector runs AT MOST once inside
+        this context, however many families/exports consult it —
+        ``snapshot()`` and ``prometheus_text()`` each open one, and a
+        caller combining both (khipu_metrics serves snapshot + derived
+        views) can wrap them in an outer pass to share the pull. The
+        cache is thread-local: re-entrant on one thread, isolated
+        across concurrent scraper threads (no torn shared cache)."""
+        st = self._scrape
+        depth = getattr(st, "depth", 0)
+        if depth == 0:
+            st.cache = None
+        st.depth = depth + 1
+        try:
+            yield self
+        finally:
+            st.depth = depth
+            if depth == 0:
+                st.cache = None
+
     def _collected(self) -> List[Tuple[str, str, Dict[str, str], object]]:
+        st = self._scrape
+        if getattr(st, "depth", 0) > 0:
+            cached = getattr(st, "cache", None)
+            if cached is not None:
+                return cached
         with self._lock:
             fns = list(self._collectors.values())
         out = []
         for fn in fns:
+            self.collector_pulls += 1
             try:
                 out.extend(fn())
             except Exception:
                 continue  # a broken collector must not break the scrape
+        if getattr(st, "depth", 0) > 0:
+            st.cache = out
         return out
 
     # ---------------------------------------------------------- exports
@@ -297,15 +358,16 @@ class MetricsRegistry:
         labeled ones map label-string -> value. One consistent pull, the
         source of truth ``khipu_metrics`` serves from."""
         out = {}
-        for name, (kind, _help, samples) in sorted(
-            self._families().items()
-        ):
-            if len(samples) == 1 and not samples[0][0]:
-                out[name] = samples[0][1]
-            else:
-                out[name] = {
-                    (_label_key(lb) or "_"): v for lb, v in samples
-                }
+        with self.scrape_pass():
+            for name, (kind, _help, samples) in sorted(
+                self._families().items()
+            ):
+                if len(samples) == 1 and not samples[0][0]:
+                    out[name] = samples[0][1]
+                else:
+                    out[name] = {
+                        (_label_key(lb) or "_"): v for lb, v in samples
+                    }
         return out
 
     def prometheus_text(self) -> str:
@@ -313,9 +375,9 @@ class MetricsRegistry:
         EXACTLY once (one ``# TYPE`` line, then every labeled sample) —
         the invariant the bench smoke test pins."""
         lines: List[str] = []
-        for name, (kind, help, samples) in sorted(
-            self._families().items()
-        ):
+        with self.scrape_pass():
+            families = sorted(self._families().items())
+        for name, (kind, help, samples) in families:
             if help:
                 lines.append(f"# HELP {name} {help}")
             lines.append(f"# TYPE {name} {kind}")

@@ -164,12 +164,39 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+def trace_sampled(trace_id: str, per_10k: int) -> bool:
+    """THE fleet-wide sampling decision: deterministic in the trace id
+    (``int(id, 16) % 10_000 < per_10k``), so the driver that mints the
+    id and every shard that later receives it via ``khipu-sampled``
+    metadata agree without coordination. Deliberately NOT Python
+    ``hash()`` — string hashing is salted per process."""
+    if per_10k >= 10_000:
+        return True
+    if per_10k <= 0:
+        return False
+    try:
+        return int(trace_id, 16) % 10_000 < per_10k
+    except ValueError:
+        return True  # non-hex id (foreign client): keep
+
+
 class Tracer:
     DEFAULT_CAPACITY = 65536
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.enabled = False
         self.capacity = capacity
+        # head-based per-trace-id sampling: ``enabled`` (the one hot-
+        # path check) is ``_on AND sampled``, where ``sampled`` is a
+        # DETERMINISTIC function of the trace id — int(id, 16), never
+        # Python hash() (PYTHONHASHSEED varies across processes) — so
+        # every process that sees this trace id makes the SAME keep/drop
+        # decision and a trace is whole or absent fleet-wide (the
+        # ``khipu-sampled`` bridge metadata carries the decision to
+        # shards that never see the id's ring). 10_000 = keep all.
+        self.sample_per_10k = 10_000
+        self.sampled = True
+        self._on = False
         # process/ring identity for cross-process propagation: rides the
         # bridge as ``khipu-trace-id`` so a shard can link its server
         # spans back to the driver ring that issued the RPC
@@ -195,17 +222,32 @@ class Tracer:
             self._last_seq = 0
         self.epoch_perf = time.perf_counter()
         self.epoch_wall = time.time()
-        self.enabled = True
+        self._on = True
+        self._recompute_sampled()
         _ensure_phase_observer()
 
     def disable(self) -> None:
+        self._on = False
         self.enabled = False
+
+    def set_sample_rate(self, per_10k: int) -> None:
+        """Head-based sampling rate: keep ``per_10k`` in 10_000 traces
+        (10_000 keeps everything — the default). Applies to the CURRENT
+        trace id immediately and to every id after a reset()."""
+        self.sample_per_10k = max(0, min(10_000, int(per_10k)))
+        self._recompute_sampled()
+
+    def _recompute_sampled(self) -> None:
+        self.sampled = trace_sampled(self.trace_id, self.sample_per_10k)
+        self.enabled = self._on and self.sampled
 
     def reset(self) -> None:
         """Drop every record and the drop counter; keep enabled state.
         A new ring gets a new trace id — remote spans linked to the old
-        ring's tokens must not alias into the new one."""
+        ring's tokens must not alias into the new one — and a fresh
+        head-based sampling decision for it."""
         self.trace_id = os.urandom(8).hex()
+        self._recompute_sampled()
         self._buf = deque(maxlen=self.capacity)
         self._seq = itertools.count(1)
         self._last_seq = 0
@@ -396,6 +438,12 @@ def apply_config(cfg, tracer_: Optional[Tracer] = None) -> None:
     if cfg is None:
         return
     t = tracer_ if tracer_ is not None else tracer
+    # a config carrying a NON-default sampling rate applies it; the
+    # default (keep-all) leaves a manually set rate alone — same
+    # no-stomp principle as enable below
+    rate = getattr(cfg, "sample_per_10k", 10_000)
+    if rate != 10_000 and rate != t.sample_per_10k:
+        t.set_sample_rate(rate)
     if cfg.enabled and not t.enabled:
         t.enable(cfg.ring_capacity)
     elif not cfg.enabled and t.enabled:
@@ -406,6 +454,14 @@ def apply_config(cfg, tracer_: Optional[Tracer] = None) -> None:
         from khipu_tpu.trie.fused import compile_cache
 
         compile_cache.set_capacity(cfg.compile_cache_capacity)
+    except Exception:
+        pass
+    try:
+        from khipu_tpu.observability.profiler import (
+            apply_config as _apply_ledger,
+        )
+
+        _apply_ledger(cfg)
     except Exception:
         pass
 

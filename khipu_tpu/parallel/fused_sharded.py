@@ -24,6 +24,7 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from khipu_tpu.observability.profiler import D2H, H2D, LEDGER
 from khipu_tpu.ops.keccak_jnp import RATE
 from khipu_tpu.parallel.mesh import AXIS
 from khipu_tpu.trie.fused import (
@@ -195,7 +196,13 @@ def fused_resolve_sharded(
     run = _build_fused_sharded(tuple(sig), rounds, n_dev, mesh)
     import jax
 
-    table = np.asarray(jax.device_get(run(*[*enc_bufs, *sub_arrays])))
+    # shard dispatch uploads the per-device buffers, the all_gather
+    # result comes back as one table — both crossings are ledger sites
+    up = sum(b.nbytes for b in enc_bufs) + sum(a.nbytes for a in sub_arrays)
+    with LEDGER.transfer("shard.dispatch", H2D, up):
+        fut = run(*[*enc_bufs, *sub_arrays])
+    with LEDGER.transfer("shard.gather", D2H, int(fut.size)):
+        table = np.asarray(jax.device_get(fut))
     out: Dict[bytes, bytes] = {}
     for nb in class_list:
         for r, ph in enumerate(classes[nb]):

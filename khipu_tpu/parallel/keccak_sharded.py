@@ -24,6 +24,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from khipu_tpu.parallel.compat import shard_map
 
+from khipu_tpu.observability.profiler import D2H, H2D, LEDGER
 from khipu_tpu.ops.keccak_jnp import LANES_PER_BLOCK, RATE, absorb
 from khipu_tpu.parallel.mesh import AXIS, pad_to_shards
 
@@ -134,8 +135,10 @@ def keccak256_fixed_sharded(data: np.ndarray, mesh: Mesh) -> np.ndarray:
     n_shards = mesh.devices.size
     padded, n = _pad_batch(np.ascontiguousarray(data, dtype=np.uint8), n_shards)
     with mesh:
-        out = _build_sharded_hash(data.shape[1], mesh)(jnp.asarray(padded))
-    return np.asarray(jax.device_get(out))[:n]
+        with LEDGER.transfer("shard.keccak", H2D, padded.nbytes):
+            out = _build_sharded_hash(data.shape[1], mesh)(jnp.asarray(padded))
+    with LEDGER.transfer("shard.keccak", D2H, padded.shape[0] * 32):
+        return np.asarray(jax.device_get(out))[:n]
 
 
 def hash_level_all_gather(data: np.ndarray, mesh: Mesh) -> np.ndarray:
@@ -144,8 +147,12 @@ def hash_level_all_gather(data: np.ndarray, mesh: Mesh) -> np.ndarray:
     n_shards = mesh.devices.size
     padded, n = _pad_batch(np.ascontiguousarray(data, dtype=np.uint8), n_shards)
     with mesh:
-        out = _build_level_all_gather(data.shape[1], mesh)(jnp.asarray(padded))
-    return np.asarray(jax.device_get(out))[:n]
+        with LEDGER.transfer("shard.keccak", H2D, padded.nbytes):
+            out = _build_level_all_gather(data.shape[1], mesh)(
+                jnp.asarray(padded)
+            )
+    with LEDGER.transfer("shard.gather", D2H, padded.shape[0] * 32):
+        return np.asarray(jax.device_get(out))[:n]
 
 
 @functools.lru_cache(maxsize=64)
@@ -186,8 +193,13 @@ def keccak256_batch_sharded(messages, mesh: Mesh):
     def run_bucket(nblocks, msgs):
         blocks = pad_to_blocks(msgs, nblocks)  # [nblocks, 34, B]
         with mesh:
-            words = _build_sharded_absorb(nblocks, mesh)(jnp.asarray(blocks))
-        return digests_to_bytes(jax.device_get(words))
+            with LEDGER.transfer("shard.keccak", H2D, blocks.nbytes):
+                words = _build_sharded_absorb(nblocks, mesh)(
+                    jnp.asarray(blocks)
+                )
+        with LEDGER.transfer("shard.keccak", D2H, blocks.shape[-1] * 32):
+            got = jax.device_get(words)
+        return digests_to_bytes(got)
 
     return bucketed_batch(
         messages,
@@ -226,7 +238,10 @@ def snapshot_verify_sharded(
     else:
         padded_keys = keys
     with mesh:
-        out = _build_sharded_verify(values.shape[1], mesh)(
-            jnp.asarray(padded_vals), jnp.asarray(padded_keys)
-        )
-    return int(jax.device_get(out))
+        up = padded_vals.nbytes + padded_keys.nbytes
+        with LEDGER.transfer("shard.verify", H2D, up):
+            out = _build_sharded_verify(values.shape[1], mesh)(
+                jnp.asarray(padded_vals), jnp.asarray(padded_keys)
+            )
+    with LEDGER.transfer("shard.verify", D2H, 4):
+        return int(jax.device_get(out))

@@ -110,12 +110,16 @@ class RegularSyncService:
     def _request_headers(
         self, peer: Peer, start, max_headers: int, reverse: bool = False
     ) -> List[BlockHeader]:
-        body = peer.request(
-            ETH_OFFSET + GET_BLOCK_HEADERS,
-            GetBlockHeaders(start, max_headers, 0, reverse).body(),
-            ETH_OFFSET + BLOCK_HEADERS,
-            timeout=self.timeout,
-        )
+        # client-side span around the peer round-trip (no wire-format
+        # change): fetch latency lands on the requesting thread's track
+        with span("sync.fetch.headers", peer=peer.remote_pub[:8],
+                  max_headers=max_headers):
+            body = peer.request(
+                ETH_OFFSET + GET_BLOCK_HEADERS,
+                GetBlockHeaders(start, max_headers, 0, reverse).body(),
+                ETH_OFFSET + BLOCK_HEADERS,
+                timeout=self.timeout,
+            )
         try:
             return decode_headers(body)
         except Exception as e:  # malformed reply IS the peer's fault
@@ -124,12 +128,14 @@ class RegularSyncService:
     def _request_bodies(
         self, peer: Peer, hashes: List[bytes]
     ) -> List[BlockBody]:
-        body = peer.request(
-            ETH_OFFSET + GET_BLOCK_BODIES,
-            list(hashes),
-            ETH_OFFSET + BLOCK_BODIES,
-            timeout=self.timeout,
-        )
+        with span("sync.fetch.bodies", peer=peer.remote_pub[:8],
+                  count=len(hashes)):
+            body = peer.request(
+                ETH_OFFSET + GET_BLOCK_BODIES,
+                list(hashes),
+                ETH_OFFSET + BLOCK_BODIES,
+                timeout=self.timeout,
+            )
         try:
             return decode_bodies(body)
         except Exception as e:  # malformed reply IS the peer's fault

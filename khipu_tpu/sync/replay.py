@@ -25,6 +25,7 @@ from khipu_tpu.domain.blockchain import Blockchain
 from khipu_tpu.domain.difficulty import calc_difficulty
 from khipu_tpu.domain.transaction import recover_senders
 from khipu_tpu.ledger.ledger import execute_block
+from khipu_tpu.observability.profiler import HOST, LEDGER
 from khipu_tpu.observability.registry import REGISTRY
 from khipu_tpu.observability.trace import (
     Tracer,
@@ -526,13 +527,15 @@ class ReplayDriver:
                 fault_point("collector.collect")
                 t0 = time.perf_counter()
                 with span("window.collect", parent=seal_tok,
-                          block_lo=lo, block_hi=hi):
+                          block_lo=lo, block_hi=hi), \
+                        LEDGER.context(window=lo, phase="collect"):
                     cm.collect(job)  # raises WindowMismatch on divergence
                 t1 = time.perf_counter()
                 fault_point("collector.persist")
                 blocks = txs = gas = ptxs = confl = 0
                 with span("window.persist", parent=seal_tok,
-                          block_lo=lo, block_hi=hi, blocks=len(results)):
+                          block_lo=lo, block_hi=hi, blocks=len(results)), \
+                        LEDGER.context(window=lo, phase="persist"):
                     for block, result in results:
                         td = (
                             self.blockchain.get_total_difficulty(
@@ -542,8 +545,15 @@ class ReplayDriver:
                         ) + block.header.difficulty
                         # world=None: the window already persisted the
                         # nodes
+                        t_save = time.perf_counter()
                         self.blockchain.save_block(
                             block, result.receipts, td, world=None
+                        )
+                        # host-side persistence: classification traffic
+                        # for window_report, never a device crossing
+                        LEDGER.record(
+                            "block.save", HOST, 0,
+                            duration=time.perf_counter() - t_save,
                         )
                         fault_point("collector.save")
                         blocks += 1
@@ -573,6 +583,7 @@ class ReplayDriver:
                     stats.gas += gas
                     stats.parallel_txs += ptxs
                     stats.conflicts += confl
+                    LEDGER.note_blocks(blocks)
                 # the window is durable (best advanced, commit mark
                 # down): the committed store now serves same-or-newer
                 # state, so the read-view overlay can let go of it
@@ -590,7 +601,9 @@ class ReplayDriver:
             hi = results_cur[-1][0].number
             t0 = time.perf_counter()
             intent_seq = None
-            with span("window.seal", block_lo=lo, block_hi=hi) as seal_sp:
+            LEDGER.note_window(lo, lo, hi)
+            with span("window.seal", block_lo=lo, block_hi=hi) as seal_sp, \
+                    LEDGER.context(window=lo, phase="seal"):
                 job = committer.seal()
                 if journal is not None:
                     # WAL barrier: the intent is durable BEFORE the job

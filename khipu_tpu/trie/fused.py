@@ -42,6 +42,7 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from khipu_tpu.observability.profiler import D2H, H2D, LEDGER
 from khipu_tpu.observability.recorder import compile_log
 from khipu_tpu.observability.trace import span as _span
 from khipu_tpu.ops.keccak_jnp import RATE
@@ -266,7 +267,12 @@ class FusedJob:
 
         fault_point("fused.collect")
         with _span("fused.collect", rows=int(self.digests.shape[0])):
-            d = np.asarray(jax.device_get(self.digests))
+            # the ONE device->host crossing of the collect phase — what
+            # the movement ledger classifies as placeholder-resolution
+            with LEDGER.transfer(
+                "fused.collect", D2H, self.digests.size
+            ):
+                d = np.asarray(jax.device_get(self.digests))
             # ONE device fetch, ONE bytes copy, then pure slicing — the
             # per-row `d[i].tobytes()` loop paid a numpy indexing round
             # per node and dominated the collect phase (BENCH_r05)
@@ -465,7 +471,17 @@ def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext) -> FusedJob:
     rounds = _pow2(depth, floor=8)  # coarse: depth 5 and 8 share a compile
     run = _build_fused(tuple(sig), rounds, use_jnp, ext_rows)
 
-    digests = run(*[*enc_bufs, *sub_arrays, ext_buf])  # async: no host sync
+    # host->device upload = every host-built input buffer (the ext tile
+    # counts only when host-built — gathered device-to-device tiles
+    # never cross the tunnel, which is the whole point of the deep
+    # pipeline). Dispatch is async, so the measured duration is the
+    # enqueue+transfer handoff, not the device compute.
+    up = sum(b.nbytes for b in enc_bufs) + sum(a.nbytes for a in sub_arrays)
+    if isinstance(ext_buf, np.ndarray):
+        up += ext_buf.nbytes
+    with LEDGER.transfer("fused.dispatch", H2D, up):
+        # async: no host sync
+        digests = run(*[*enc_bufs, *sub_arrays, ext_buf])
     try:
         # start the device->host copy NOW: it streams as soon as the
         # fixpoint finishes, so collect()'s device_get returns without

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Bench regression gate: tier-1 tests + bench.py --compare against a
+# captured baseline. Non-zero exit on a test failure OR a bench
+# regression past the thresholds — the one command CI (or a human
+# about to merge) runs to know the change neither broke correctness
+# nor quietly regressed the headline replay configs.
+#
+# Usage:
+#   scripts/bench_gate.sh [BASELINE.json] [extra bench.py args...]
+#
+# Defaults: BENCH_r05.json (the newest captured baseline) and the
+# default thresholds baked into bench.py (blocks/s may drop to 0.5x,
+# collect share may grow +0.15 absolute, device bytes/block may grow
+# 1.25x — see DEFAULT_COMPARE_THRESHOLDS). Override per-run, e.g.:
+#   scripts/bench_gate.sh BENCH_r05.json --min-blocks-ratio=0.8
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+BASELINE="${1:-BENCH_r05.json}"
+shift || true
+
+if [ ! -f "$BASELINE" ]; then
+    echo "bench_gate: baseline '$BASELINE' not found" >&2
+    exit 2
+fi
+
+echo "== tier-1 tests =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== bench regression gate (baseline: $BASELINE) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-}" python bench.py \
+    --compare="$BASELINE" "$@"
+
+echo "bench_gate: OK"

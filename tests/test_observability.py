@@ -297,7 +297,11 @@ class TestExport:
         doc = json.loads(path.read_text())
         events = doc["traceEvents"]
         assert events and doc["displayTimeUnit"] == "ms"
-        assert all(e["ph"] in ("M", "X", "i", "s", "f") for e in events)
+        # "C" = the counter tracks (export.counter_tracks) every dump
+        # now carries — occupancy timeline + transfer-ledger bytes
+        assert all(e["ph"] in ("M", "X", "i", "s", "f", "C") for e in events)
+        cs = [e for e in events if e["ph"] == "C"]
+        assert cs and all("ts" in e and e["args"] for e in cs)
         # every complete event carries microsecond ts + dur
         xs = [e for e in events if e["ph"] == "X"]
         assert xs and all(e["dur"] >= 0 and "ts" in e for e in xs)

@@ -118,8 +118,9 @@ class TestPropagation:
 
     def test_unsampled_call_carries_no_remote_tags(self, shard):
         """Tracing off on the caller: the metadata keys still ship
-        (khipu-sampled=0) but the server must NOT record a remote
-        linkage into a trace id that never recorded the client half."""
+        (khipu-sampled="") but the server must NOT record a remote
+        linkage into a trace id that never recorded the client half —
+        it keeps its own local, unlinked serve span."""
         server, client = shard
         assert not tracer.enabled
         client.best_block()
@@ -130,6 +131,26 @@ class TestPropagation:
         assert len(serves) == 1
         assert "remote_trace" not in serves[0].tags
         assert "remote_parent" not in serves[0].tags
+
+    def test_head_sampled_out_trace_skips_server_span(self, shard):
+        """khipu-sampled="0" is a DECISION, not an absence: the caller's
+        head sampler dropped this trace id, so the server records
+        nothing — the trace is whole or absent fleet-wide."""
+        server, client = shard
+        tracer.enable()
+        tracer.set_sample_rate(0)  # tracer on, every trace dropped
+        try:
+            assert not tracer.enabled
+            client.best_block()
+        finally:
+            tracer.set_sample_rate(10_000)
+            tracer.disable()
+            tracer.reset()
+        serves = [
+            s for s in server.tracer.snapshot()
+            if s.name == "bridge.serve.BestBlock"
+        ]
+        assert serves == []
 
     def test_metadata_keys_are_unconditional(self, shard):
         """Wire contract: all three keys ride EVERY call — sampled
@@ -161,7 +182,7 @@ class TestPropagation:
         off, on = captured
         for md in (off, on):
             assert {MD_TRACE_ID, MD_PARENT_TOKEN, MD_SAMPLED} <= set(md)
-        assert off[MD_SAMPLED] == "0"
+        assert off[MD_SAMPLED] == ""  # off = no sampling decision
         assert off[MD_PARENT_TOKEN] == ""  # no live span when off
         assert on[MD_SAMPLED] == "1"
         assert on[MD_TRACE_ID] == live_trace_id
