@@ -1,0 +1,38 @@
+"""khipu-lint: AST invariant analysis for the khipu_tpu tree.
+
+The repo's correctness story rests on invariants that no runtime test
+can see being *absent*: every host<->device crossing must be metered by
+the TransferLedger or the bytes-budget gate lies (docs/roofline.md),
+chaos ``InjectedDeath`` (a BaseException with SIGKILL semantics) must
+never be swallowed by a broad except, deterministic replay must not
+touch wall-clock or unseeded RNG, and the 40+ ``threading.Lock`` sites
+across the collector/cluster/serving/txpool planes have no runtime
+check of acquisition order. This package derives those disciplines
+statically — the Eraser-lockset move applied at build time — and fails
+the gate when code drifts (scripts/lint_gate.sh).
+
+Pure stdlib (``ast`` + ``tokenize``); importing it never pulls jax or
+any runtime module, so the gate runs in milliseconds on any machine.
+
+Rules (docs/static_analysis.md has the catalog with rationale):
+
+* KL001 — unledgered device crossings
+* KL002 — chaos-unsafe broad excepts
+* KL003 — nondeterminism in deterministic paths
+* KL004 — lock-order cycles + blocking calls under a lock
+* KL005 — observability discipline (spans / registry families)
+* KL006 — mutable default arguments
+
+Per-site suppression: ``# khipu-lint: ok KL00x <reason>`` on the
+flagged line or the line above. Residual accepted findings live in the
+committed ``baseline.json`` next to this file.
+"""
+
+from khipu_tpu.analysis.core import (
+    Finding,
+    Project,
+    load_baseline,
+    run_analysis,
+)
+
+__all__ = ["Finding", "Project", "load_baseline", "run_analysis"]

@@ -149,6 +149,7 @@ class ShardedNodeClient:
         sleep: Callable[[float], None] = time.sleep,
         rpc_deadline: Optional[float] = None,
         missed_cap: int = 100_000,
+        jitter_seed: int = 0,
     ):
         if not endpoints:
             raise ValueError("cluster needs at least one endpoint")
@@ -164,6 +165,11 @@ class ShardedNodeClient:
         self.backoff_max = backoff_max
         self._clock = clock
         self._sleep = sleep
+        # retry-backoff jitter from a per-client seeded stream
+        # (ClusterConfig.jitter_seed): chaos replay of a retry schedule
+        # is bit-reproducible — module-level random would diverge per
+        # run and break deterministic fault replay (KL003)
+        self._jitter_rng = random.Random(jitter_seed)
         self._channel_factory = channel_factory or self._grpc_factory
         self._channels: Dict[str, object] = {}
         self._channel_lock = threading.Lock()
@@ -256,7 +262,9 @@ class ShardedNodeClient:
                         self.backoff_max,
                         self.backoff_base * (2**attempt),
                     )
-                    self._sleep(delay * (0.5 + random.random() / 2))
+                    self._sleep(
+                        delay * (0.5 + self._jitter_rng.random() / 2)
+                    )
                 continue
             m.latency_ns += int((self._clock() - t0) * 1e9)
             breaker.record_success()

@@ -186,6 +186,10 @@ class ClusterConfig:
     # surfaces as DEADLINE_EXCEEDED into the retry/breaker machinery
     # instead of blocking a reader forever. None = no deadline
     rpc_deadline: Optional[float] = 10.0
+    # seed for the client's retry-backoff jitter stream: the retry
+    # schedule must replay bit-identically under the chaos harness
+    # (KL003 — no unseeded RNG on cluster paths)
+    jitter_seed: int = 0
 
 
 @dataclass(frozen=True)

@@ -176,6 +176,10 @@ def _cb_exists(h, addr):
     host = _host(h)
     try:
         return 1 if host.world.account_exists(_addr(addr)) else 0
+    # khipu-lint: ok KL002 ctypes callback boundary: raising here
+    # would corrupt the native stack — the exception (incl.
+    # InjectedDeath) is captured to host.exc and re-raised on the
+    # host side as soon as the native call returns (_run)
     except BaseException as e:  # noqa: BLE001 — must not cross ctypes
         host.exc = host.exc or e
         return 0
@@ -186,6 +190,8 @@ def _cb_is_dead(h, addr):
     host = _host(h)
     try:
         return 1 if host.world.is_dead(_addr(addr)) else 0
+    # khipu-lint: ok KL002 captured to host.exc; re-raised after the
+    # native call returns (see _cb_exists note)
     except BaseException as e:  # noqa: BLE001
         host.exc = host.exc or e
         return 1
@@ -207,6 +213,8 @@ def _cb_get_account(h, addr, out):
                 + acc.code_hash
             )
         C.memmove(out, buf, 73)
+    # khipu-lint: ok KL002 captured to host.exc; re-raised after the
+    # native call returns (see _cb_exists note)
     except BaseException as e:  # noqa: BLE001
         host.exc = host.exc or e
         C.memmove(out, b"\x00" * 73, 73)
@@ -217,6 +225,8 @@ def _cb_get_code_hash(h, addr, out):
     host = _host(h)
     try:
         C.memmove(out, host.world.get_code_hash(_addr(addr)), 32)
+    # khipu-lint: ok KL002 captured to host.exc; re-raised after the
+    # native call returns (see _cb_exists note)
     except BaseException as e:  # noqa: BLE001
         host.exc = host.exc or e
         C.memmove(out, b"\x00" * 32, 32)
@@ -227,6 +237,8 @@ def _cb_get_code(h, addr, out_ptr, out_len):
     host = _host(h)
     try:
         code = host.world.get_code(_addr(addr))
+    # khipu-lint: ok KL002 captured to host.exc; re-raised after the
+    # native call returns (see _cb_exists note)
     except BaseException as e:  # noqa: BLE001
         host.exc = host.exc or e
         code = b""
@@ -243,6 +255,8 @@ def _cb_get_storage(h, addr, key, out):
             _addr(addr), int.from_bytes(C.string_at(key, 32), "big")
         )
         C.memmove(out, v.to_bytes(32, "big"), 32)
+    # khipu-lint: ok KL002 captured to host.exc; re-raised after the
+    # native call returns (see _cb_exists note)
     except BaseException as e:  # noqa: BLE001
         host.exc = host.exc or e
         C.memmove(out, b"\x00" * 32, 32)
@@ -256,6 +270,8 @@ def _cb_get_original(h, addr, key, out):
             _addr(addr), int.from_bytes(C.string_at(key, 32), "big")
         )
         C.memmove(out, v.to_bytes(32, "big"), 32)
+    # khipu-lint: ok KL002 captured to host.exc; re-raised after the
+    # native call returns (see _cb_exists note)
     except BaseException as e:  # noqa: BLE001
         host.exc = host.exc or e
         C.memmove(out, b"\x00" * 32, 32)
@@ -266,6 +282,8 @@ def _cb_blockhash(h, number, out):
     host = _host(h)
     try:
         bh = host.world.get_block_hash(number)
+    # khipu-lint: ok KL002 captured to host.exc; re-raised after the
+    # native call returns (see _cb_exists note)
     except BaseException as e:  # noqa: BLE001
         host.exc = host.exc or e
         bh = None
@@ -296,6 +314,8 @@ def _cb_precompile(h, addr_low, inp, inlen, gas, out_ptr, out_len, gas_left):
         out_len[0] = len(out)
         gas_left[0] = gas - cost
         return 0
+    # khipu-lint: ok KL002 captured to host.exc; re-raised after the
+    # native call returns (see _cb_exists note)
     except BaseException as e:  # noqa: BLE001
         host.exc = host.exc or e
         gas_left[0] = 0

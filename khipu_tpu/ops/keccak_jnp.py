@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from khipu_tpu.base.crypto.keccak import ROTATION, ROUND_CONSTANTS
+from khipu_tpu.observability.profiler import D2H, H2D, LEDGER
 
 RATE = 136  # keccak-256 rate in bytes
 LANES_PER_BLOCK = RATE // 8  # 17 u64 lanes absorbed per block
@@ -239,8 +240,11 @@ def keccak256_batch_jnp(messages: Sequence[bytes]) -> List[bytes]:
 
     def run_bucket(nblocks, msgs):
         blocks = pad_to_blocks(msgs, nblocks)
-        words = absorb(jnp.asarray(blocks), nblocks)
-        return digests_to_bytes(jax.device_get(words))
+        with LEDGER.transfer("ops.keccak", H2D, blocks.nbytes):
+            words = absorb(jnp.asarray(blocks), nblocks)
+        with LEDGER.transfer("ops.keccak", D2H, int(words.size) * 4):
+            got = jax.device_get(words)
+        return digests_to_bytes(got)
 
     return bucketed_batch(
         messages, lambda nblocks, n: pad_batch_count(n), run_bucket

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from khipu_tpu.observability.profiler import D2H, H2D, LEDGER
 from khipu_tpu.ops.keccak_jnp import (
     _RC32,
     _round,
@@ -251,10 +252,13 @@ def keccak256_fixed(
         extra[:, length] ^= 0x01
         extra[:, nblocks * RATE - 1] ^= 0x80
         padded = np.concatenate([padded, extra], axis=0)
-    out = _build_from_words(nblocks, interpret)(
-        jnp.asarray(padded.view("<u4"))
-    )
-    digest_words = np.asarray(jax.device_get(out), dtype="<u4")[:n]
+    with LEDGER.transfer("ops.keccak", H2D, padded.nbytes):
+        out = _build_from_words(nblocks, interpret)(
+            jnp.asarray(padded.view("<u4"))
+        )
+    with LEDGER.transfer("ops.keccak", D2H, int(out.size) * 4):
+        got = jax.device_get(out)
+    digest_words = np.asarray(got, dtype="<u4")[:n]
     return digest_words.view(np.uint8).reshape(n, 32)
 
 
@@ -304,8 +308,11 @@ def keccak256_batch_pallas(
         rows_per_chunk = MAX_TILES * TILE
         chunks = []
         for start in range(0, packed.shape[0], rows_per_chunk):
-            words = run(jnp.asarray(packed[start : start + rows_per_chunk]))
-            chunks.append(np.asarray(jax.device_get(words), dtype="<u4"))
+            chunk = packed[start : start + rows_per_chunk]
+            with LEDGER.transfer("ops.keccak", H2D, chunk.nbytes):
+                words = run(jnp.asarray(chunk))
+            with LEDGER.transfer("ops.keccak", D2H, int(words.size) * 4):
+                chunks.append(np.asarray(jax.device_get(words), dtype="<u4"))
         arr = np.concatenate(chunks, axis=0)  # (B, 8) digest words
         return [arr[j].tobytes() for j in range(len(msgs))]
 

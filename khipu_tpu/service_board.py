@@ -204,6 +204,7 @@ class ServiceBoard:
             breaker_reset=cc.breaker_reset,
             local_get=local_only,
             rpc_deadline=cc.rpc_deadline,
+            jitter_seed=cc.jitter_seed,
         )
         self.storages.account_node_storage = (
             RemoteReadThroughNodeStorage.from_cluster(

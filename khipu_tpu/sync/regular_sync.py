@@ -482,9 +482,11 @@ class RegularSyncService:
             max_seconds: float = 60.0) -> None:
         """Loop sync_once until ``until()`` or timeout (test harness /
         node main-loop form)."""
-        deadline = time.time() + max_seconds
+        # monotonic: this deadline is pure elapsed-time bookkeeping —
+        # wall-clock here would jump with NTP steps AND trip KL003
+        deadline = time.monotonic() + max_seconds
         while not until():
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise SyncAborted("regular sync timed out")
             if self.sync_once() == 0:
                 time.sleep(poll)

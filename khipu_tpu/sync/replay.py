@@ -261,6 +261,11 @@ class _WindowCollector:
                 # liveness checks raise CollectorDied; _current stays
                 # set so take_pending can re-run the torn job.
                 return
+            # khipu-lint: ok KL002 InjectedDeath is handled by the
+            # dedicated handler above (thread stops, SIGKILL
+            # semantics); everything else is RECORDED as _failure and
+            # re-raised on the driver by submit()/drain() — fail-stop
+            # is preserved, not swallowed
             except BaseException as exc:  # surfaces on the driver
                 with self._cv:
                     self._failure = exc

@@ -25,6 +25,9 @@ if [ ! -f "$BASELINE" ]; then
     exit 2
 fi
 
+echo "== khipu-lint static analysis =="
+scripts/lint_gate.sh
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Static-analysis gate: khipu-lint self-scan of the khipu_tpu tree.
+# Non-zero exit on any finding that is neither pragma-annotated
+# (# khipu-lint: ok KL00x <reason>) nor in the committed baseline
+# (khipu_tpu/analysis/baseline.json) — the invariants it checks are
+# the ones no runtime test can see being absent: TransferLedger
+# coverage of device crossings (KL001), chaos fail-stop safety
+# (KL002), replay determinism (KL003), lock order (KL004),
+# observability discipline (KL005), mutable defaults (KL006).
+# docs/static_analysis.md has the catalog.
+#
+# Usage:
+#   scripts/lint_gate.sh [paths...] [--format=json] [...]
+#
+# Pure stdlib — no jax import, runs in milliseconds anywhere.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+python -m khipu_tpu.analysis "${@:-khipu_tpu}"

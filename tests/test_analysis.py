@@ -1,0 +1,351 @@
+"""khipu-lint (khipu_tpu/analysis/ — docs/static_analysis.md).
+
+Per-rule known-bad fixtures prove each rule still fires; pragma and
+baseline tests prove both suppression channels; the lock-cycle fixture
+proves KL004's order analysis; the self-scan tests pin the acceptance
+gate — the committed tree is clean modulo a near-empty baseline and
+has zero lock-order cycles.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from khipu_tpu.analysis import run_analysis
+from khipu_tpu.analysis.core import Finding, load_baseline, load_project
+from khipu_tpu.analysis.lockorder import LockOrderAnalysis
+from khipu_tpu.analysis.report import render_json
+from khipu_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _scan(tmp_path, files, rules=None):
+    """Write {relpath: source} under tmp_path and lint it with an
+    empty baseline; returns the new findings."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    result = run_analysis([str(tmp_path)], rules=rules, baseline={})
+    return result["findings"]
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------ per-rule fixtures
+
+
+class TestRuleFixtures:
+    def test_kl001_unledgered_crossing_fires(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "import jax\n"
+            "def pull(x):\n"
+            "    return jax.device_get(x)\n"
+        )})
+        assert _rules_of(findings) == ["KL001"]
+        assert "device_get" in findings[0].message
+        assert findings[0].context == "pull"
+
+    def test_kl001_metered_forms_are_clean(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "import jax\n"
+            "def timed(x):\n"
+            "    with LEDGER.transfer('s', 'd2h', 4):\n"
+            "        return jax.device_get(x)\n"
+            "def oneshot(x):\n"
+            "    out = jax.device_get(x)\n"
+            "    LEDGER.record('s', 'd2h', 4)\n"
+            "    return out\n"
+        )})
+        assert findings == []
+
+    def test_kl001_block_until_ready_and_from_import(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "from jax import device_put\n"
+            "def up(arr, x):\n"
+            "    arr.block_until_ready()\n"
+            "    return device_put(x)\n"
+        )})
+        assert [f.rule for f in findings] == ["KL001", "KL001"]
+
+    def test_kl002_broad_except_without_reraise_fires(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "def swallow():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except:\n"
+            "        pass\n"
+            "def swallow2():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except BaseException:\n"
+            "        log()\n"
+        )})
+        assert [f.rule for f in findings] == ["KL002", "KL002"]
+
+    def test_kl002_reraise_and_narrow_except_are_clean(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "def ok():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except BaseException:\n"
+            "        cleanup()\n"
+            "        raise\n"
+            "def narrow():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except ValueError:\n"
+            "        pass\n"
+        )})
+        assert findings == []
+
+    def test_kl003_fires_only_in_protected_paths(self, tmp_path):
+        src = (
+            "import time, random\n"
+            "def jitter():\n"
+            "    return time.time() + random.random()\n"
+        )
+        # same source: flagged under sync/, ignored under tools/
+        bad = _scan(tmp_path, {"sync/mod.py": src})
+        assert [f.rule for f in bad] == ["KL003", "KL003"]
+        ok = _scan(tmp_path, {"tools/mod.py": src})
+        assert [f for f in ok if f.path.endswith("tools/mod.py")] == []
+
+    def test_kl003_seeded_rng_is_clean(self, tmp_path):
+        findings = _scan(tmp_path, {"sync/mod.py": (
+            "import random\n"
+            "RNG = random.Random(7)\n"
+            "def jitter():\n"
+            "    return RNG.random()\n"
+        )})
+        assert findings == []
+
+    def test_kl004_lock_order_cycle_detected(self, tmp_path):
+        files = {"locks.py": (
+            "import threading\n"
+            "A = threading.Lock()\n"
+            "B = threading.Lock()\n"
+            "def ab():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+            "def ba():\n"
+            "    with B:\n"
+            "        with A:\n"
+            "            pass\n"
+        )}
+        findings = _scan(tmp_path, files, rules=[RULES_BY_ID["KL004"]])
+        assert any(
+            f.rule == "KL004" and "cycle" in f.message for f in findings
+        )
+        # the gate surface agrees: one SCC spanning both locks
+        project = load_project([str(tmp_path)])
+        cycles = LockOrderAnalysis(project).cycles()
+        assert len(cycles) == 1 and len(cycles[0]) == 2
+
+    def test_kl004_blocking_call_under_lock_warns(self, tmp_path):
+        findings = _scan(tmp_path, {"locks.py": (
+            "import threading, time\n"
+            "A = threading.Lock()\n"
+            "def hold_and_sleep():\n"
+            "    with A:\n"
+            "        time.sleep(1)\n"
+        )}, rules=[RULES_BY_ID["KL004"]])
+        assert any(
+            f.rule == "KL004" and "sleep" in f.message for f in findings
+        )
+
+    def test_kl004_consistent_order_is_clean(self, tmp_path):
+        findings = _scan(tmp_path, {"locks.py": (
+            "import threading\n"
+            "A = threading.Lock()\n"
+            "B = threading.Lock()\n"
+            "def ab():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+            "def ab2():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+        )}, rules=[RULES_BY_ID["KL004"]])
+        assert findings == []
+
+    def test_kl005_span_outside_with_fires(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "def f():\n"
+            "    sp = span('work')\n"
+            "def ok():\n"
+            "    with span('work'):\n"
+            "        pass\n"
+        )})
+        assert [f.rule for f in findings] == ["KL005"]
+        assert findings[0].context == "f"
+
+    def test_kl005_registry_family_in_function_fires(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "def lazy(registry):\n"
+            "    return registry.counter('n')\n"
+            "def labeled_child(registry):\n"
+            "    return registry.counter('n', labels={'k': 'v'})\n"
+        )})
+        assert [f.rule for f in findings] == ["KL005"]
+        assert findings[0].context == "lazy"
+
+    def test_kl006_mutable_default_fires(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "def f(x=[]):\n"
+            "    return x\n"
+            "def g(*, y={}):\n"
+            "    return y\n"
+            "def ok(z=(), w=None):\n"
+            "    return z, w\n"
+        )})
+        assert [f.rule for f in findings] == ["KL006", "KL006"]
+
+    def test_kl000_parse_error_reported(self, tmp_path):
+        findings = _scan(tmp_path, {"broken.py": "def f(:\n"})
+        assert [f.rule for f in findings] == ["KL000"]
+
+
+# ------------------------------------------------------------ suppression
+
+
+class TestSuppression:
+    BAD = "def f(x=[]):\n    return x\n"
+
+    def test_pragma_on_line_suppresses(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "def f(x=[]):  # khipu-lint: ok KL006 fixture\n"
+            "    return x\n"
+        )})
+        assert findings == []
+
+    def test_pragma_block_above_suppresses(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "# khipu-lint: ok KL006 the reason spans a comment\n"
+            "# block; the pragma may sit anywhere inside it\n"
+            "def f(x=[]):\n"
+            "    return x\n"
+        )})
+        assert findings == []
+
+    def test_pragma_for_other_rule_does_not_suppress(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "def f(x=[]):  # khipu-lint: ok KL001 wrong rule\n"
+            "    return x\n"
+        )})
+        assert [f.rule for f in findings] == ["KL006"]
+
+    def test_pragma_inside_string_is_inert(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "P = '# khipu-lint: ok KL006 not a comment'\n"
+            "def f(x=[]):\n"
+            "    return x\n"
+        )})
+        assert [f.rule for f in findings] == ["KL006"]
+
+    def test_baseline_suppresses_and_line_drift_survives(self, tmp_path):
+        first = _scan(tmp_path, {"mod.py": self.BAD})
+        assert len(first) == 1
+        baseline = {f.fingerprint: {"rule": f.rule} for f in first}
+        # shift the finding down two lines — fingerprint is line-free
+        (tmp_path / "mod.py").write_text("import os\nimport sys\n"
+                                         + self.BAD)
+        result = run_analysis([str(tmp_path)], baseline=baseline)
+        assert result["findings"] == []
+        assert [f.rule for f in result["baselined"]] == ["KL006"]
+        assert result["stale"] == []
+
+    def test_stale_baseline_entries_surface(self, tmp_path):
+        (tmp_path / "mod.py").write_text("def ok():\n    pass\n")
+        baseline = {"KL006|gone.py|f|msg": {"rule": "KL006",
+                                            "path": "gone.py"}}
+        result = run_analysis([str(tmp_path)], baseline=baseline)
+        assert result["findings"] == []
+        assert len(result["stale"]) == 1
+
+
+# ------------------------------------------------------- report + CLI
+
+
+class TestReportAndCli:
+    def test_json_report_is_valid_sarif_ish(self, tmp_path):
+        findings = _scan(tmp_path, {"mod.py": (
+            "def f(x=[]):\n    return x\n"
+        )})
+        doc = json.loads(render_json(findings, [], []))
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {r.id for r in ALL_RULES} <= rule_ids
+        res = run["results"][0]
+        assert res["ruleId"] == "KL006"
+        assert res["message"]["text"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("mod.py")
+        assert loc["region"]["startLine"] >= 1
+
+    def test_cli_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(x=[]):\n    return x\n")
+        good = tmp_path / "good.py"
+        good.write_text("def f(x=None):\n    return x\n")
+
+        def lint(*argv):
+            return subprocess.run(
+                [sys.executable, "-m", "khipu_tpu.analysis", *argv],
+                cwd=REPO_ROOT, capture_output=True, text=True,
+            )
+
+        r = lint(str(good), "--no-baseline")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "clean" in r.stdout
+        r = lint(str(bad), "--no-baseline")
+        assert r.returncode == 1
+        assert "KL006" in r.stdout
+        r = lint(str(bad), "--no-baseline", "--format=json")
+        assert r.returncode == 1
+        assert json.loads(r.stdout)["runs"][0]["results"]
+
+    def test_cli_rules_filter(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(x=[]):\n    return x\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "khipu_tpu.analysis", str(bad),
+             "--no-baseline", "--rules", "KL001"],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+        )
+        assert r.returncode == 0  # KL006 not selected
+
+
+# --------------------------------------------------- self-scan (the gate)
+
+
+class TestSelfScan:
+    def test_committed_tree_is_clean_modulo_baseline(self):
+        """The acceptance gate: `python -m khipu_tpu.analysis
+        khipu_tpu/` exits 0 on the committed tree."""
+        r = subprocess.run(
+            [sys.executable, "-m", "khipu_tpu.analysis", "khipu_tpu"],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_baseline_stays_near_empty(self):
+        assert len(load_baseline()) <= 5
+
+    def test_repo_has_no_lock_order_cycles(self):
+        project = load_project([str(REPO_ROOT / "khipu_tpu")])
+        assert LockOrderAnalysis(project).cycles() == []
+
+    def test_finding_fingerprint_is_line_free(self):
+        a = Finding("KL006", "error", "p.py", 10, "m", "f")
+        b = Finding("KL006", "error", "p.py", 99, "m", "f")
+        assert a.fingerprint == b.fingerprint

@@ -402,6 +402,63 @@ class TestDegrade:
         collector._thread.join(timeout=5)
 
 
+# ----------------------------------------------- fail-stop handler audit
+
+
+class TestFailStopHandlerAudit:
+    """KL002 audit (docs/static_analysis.md): sync/replay.py keeps two
+    broad ``except BaseException`` handlers on purpose. This class pins
+    the property the pragma annotations claim — a chaos ``die``
+    (InjectedDeath) inside each still fail-stops instead of being
+    swallowed into a recoverable-looking error."""
+
+    def test_die_in_collect_job_is_not_recorded_as_failure(self):
+        """Worker-side handler (_WindowCollector._run): InjectedDeath
+        must take the dedicated death path — the thread just stops with
+        NO ``_failure`` record (recording it would downgrade a process
+        death to an ordinary abort that submit() re-raises), and the
+        torn job stays current so take_pending can re-run it."""
+        from khipu_tpu.sync.replay import _WindowCollector
+
+        collector = _WindowCollector(2, join_timeout=5.0)
+
+        def torn_job():
+            raise InjectedDeath("die inside collect job")
+
+        collector.submit(torn_job)
+        collector._thread.join(timeout=5)
+        assert not collector._thread.is_alive()
+        # SIGKILL semantics: death is NOT a recorded failure ...
+        assert collector._failure is None
+        # ... the driver learns of it through the liveness check ...
+        with pytest.raises(CollectorDied):
+            collector.submit(lambda: None)
+        # ... and the half-done job is first in line for the re-run
+        assert collector.take_pending() == [torn_job]
+
+    def test_die_at_fused_dispatch_escapes_replay(self, chain):
+        """Driver-side handler (ReplayDriver.replay): a ``die`` at the
+        fused.dispatch fault point must NOT be absorbed by the
+        per-window host-fallback catch (``except Exception`` — too
+        narrow for BaseException by design); the driver's broad handler
+        kills the pipeline and re-raises, so the simulated process
+        death escapes replay() instead of degrading."""
+        from khipu_tpu.trie.bulk import host_hasher
+
+        cfg = _cfg(window=2, depth=2)
+        bc = _fresh(cfg)
+        driver = ReplayDriver(bc, cfg, device_commit=True)
+        driver.hasher = host_hasher  # fires before any XLA compile
+        plan = FaultPlan(
+            seed=3, rules=[FaultRule("fused.dispatch", "die")]
+        )
+        with active(plan):
+            with pytest.raises(InjectedDeath):
+                driver.replay(chain)
+        # fail-stop: the chain stops strictly short of the fixture tip
+        assert bc.best_block_number < N_BLOCKS
+
+
 # ------------------------------------------------------ serving chaos
 
 
