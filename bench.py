@@ -148,8 +148,15 @@ def _trace_report(stats):
     # collect dominating; this says WHICH bytes it moved)
     movement = {}
     if LEDGER.enabled and LEDGER.blocks:
+        by_phase = LEDGER.phase_bytes_per_block()
         movement = {
-            "bytes_per_block_by_phase": LEDGER.phase_bytes_per_block(),
+            "bytes_per_block_by_phase": by_phase,
+            # the device-resident commit's headline number: collect
+            # must fetch only the 32 B/block root digests — anything
+            # bigger means node bytes crossed d2h on the critical path
+            "collect_d2h_bytes_per_block": (
+                by_phase.get("collect", {}).get("d2h", 0)
+            ),
             "device_bytes_total": LEDGER.direction_totals(),
             "ledger_blocks": LEDGER.blocks,
             "transfer_events": LEDGER.recorded,
@@ -1046,6 +1053,62 @@ def bench_compare(path, thresholds=None, runners=None):
     return 1 if failures else 0
 
 
+def bench_capture(out_path, runners=None):
+    """``bench.py --capture=BENCH_rNN.json``: run the same headline
+    replay configs the --compare gate re-runs, with the TransferLedger
+    on, and write a BENCH-style baseline document whose metric lines
+    carry the movement block (bytes/block by CURRENT phase names,
+    collect-phase d2h) — a baseline captured this way lets the next
+    --compare enforce the bytes-per-block ratio instead of skipping it
+    (pre-ledger captures like BENCH_r05 have no movement numbers)."""
+    from khipu_tpu.observability.profiler import LEDGER
+
+    if runners is None:
+        runners = [
+            lambda: bench_replay(
+                32, 50, "replay_parallel_commit_fixture_blocks_per_sec",
+                parallel=True, window=8,
+            ),
+            bench_replay_contended,
+        ]
+    lines = []
+    LEDGER.enable()
+    try:
+        for run in runners:
+            LEDGER.reset()  # per-config movement numbers
+            mark = len(_EMITTED)
+            run()
+            movement = {}
+            if LEDGER.blocks:
+                by_phase = LEDGER.phase_bytes_per_block()
+                movement = {
+                    "device_bytes_total": LEDGER.direction_totals(),
+                    "ledger_blocks": LEDGER.blocks,
+                    "bytes_per_block_by_phase": by_phase,
+                    "collect_d2h_bytes_per_block": (
+                        by_phase.get("collect", {}).get("d2h", 0)
+                    ),
+                }
+            for line in _EMITTED[mark:]:
+                row = dict(line)
+                if movement:
+                    row["movement"] = movement
+                lines.append(row)
+    finally:
+        LEDGER.disable()
+    doc = {
+        "cmd": f"python bench.py --capture={out_path}",
+        "rc": 0,
+        "tail": "\n".join(json.dumps(ln) for ln in lines),
+        "parsed": lines[-1] if lines else None,
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(f"captured {len(lines)} metric line(s) -> {out_path}",
+          file=sys.stderr)
+
+
 def _serve_setup(n_blocks, txs_per_block, window=2, depth=2):
     """Fixture chain + fresh target + serving plane wired the way
     ServiceBoard.start_serving does it, but with bench-scaled admission
@@ -1329,6 +1392,9 @@ def main() -> None:
     compare_path = None
     thresholds = {}
     for arg in sys.argv[1:]:
+        if arg.startswith("--capture="):
+            bench_capture(arg.split("=", 1)[1])
+            return
         if arg.startswith("--compare="):
             compare_path = arg.split("=", 1)[1]
         elif arg.startswith("--min-blocks-ratio="):

@@ -13,6 +13,12 @@ A crossing counts as metered when it is lexically inside a
 also calls ``*LEDGER*.record(...)`` (the one-shot form used where the
 upload is async and the timing context would double-count — see
 storage/device_mirror.py mirror.init).
+
+The rule also validates the SITE STRING of every ledger call against
+``profiler.KNOWN_SITES``: a misspelled site is metered in the totals
+but silently forks a new series in ``khipu_device_transfer_*`` and
+drops out of its COLLECT_CLASSES stream — the window report then
+under-attributes exactly the bytes the site was added to explain.
 """
 
 from __future__ import annotations
@@ -72,6 +78,37 @@ def _crossing_name(call: ast.Call, mods: Set[str],
     return ""
 
 
+def _known_sites() -> Set[str]:
+    """The runtime site registry — imported lazily so the analyzer can
+    still scan trees where observability fails to import."""
+    try:
+        from khipu_tpu.observability.profiler import KNOWN_SITES
+
+        return set(KNOWN_SITES)
+    except Exception:  # pragma: no cover - defensive
+        return set()
+
+
+def _ledger_site_arg(call: ast.Call) -> str | None:
+    """The literal site string of a ``*LEDGER*.transfer(...)`` /
+    ``*LEDGER*.record(...)`` call, or None when the call is not a
+    ledger call or the site is not a string literal (dynamic sites are
+    out of the rule's reach)."""
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in (
+        "transfer", "record"
+    ):
+        return None
+    if "ledger" not in ast.unparse(f.value).lower():
+        return None
+    if not call.args:
+        return None
+    a0 = call.args[0]
+    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+        return a0.value
+    return None
+
+
 def _function_records_to_ledger(node: ast.AST) -> bool:
     fn = parent(node)
     while fn is not None and not isinstance(
@@ -101,9 +138,26 @@ class Rule:
     def check_module(self, mod: Module) -> Iterator[Finding]:
         if mod.path.endswith(_EXEMPT_SUFFIXES):
             return
+        known = _known_sites()
         mods, names = _jax_aliases(mod.tree)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
+                continue
+            site = _ledger_site_arg(node)
+            if site is not None and known and site not in known:
+                yield Finding(
+                    rule=self.id,
+                    severity=self.severity,
+                    path=mod.path,
+                    line=node.lineno,
+                    message=(
+                        f"unknown TransferLedger site {site!r} — not "
+                        "in profiler.KNOWN_SITES (a misspelled site "
+                        "forks its own metrics series and drops out "
+                        "of the window report's class breakdown)"
+                    ),
+                    context=enclosing_function(node),
+                )
                 continue
             name = _crossing_name(node, mods, names)
             if not name:

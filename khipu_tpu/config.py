@@ -129,6 +129,15 @@ class SyncConfig:
     degrade_on_collector_death: bool = True
     # close()/kill() raise/warn when the worker outlives this join
     collector_join_timeout: float = 60.0
+    # device-resident window commit (storage/device_mirror.py): the
+    # collect stage admits the window's live nodes into the device
+    # mirror d2d and only the async persist stage spills them to host
+    # storage — collect-phase d2h collapses to the 32 B/block root
+    # fetch. Requires a device hasher; ignored for the host oracle
+    device_mirror_commit: bool = True
+    # mirror ring capacity in rows (TILE=1024 multiples per class;
+    # total across classes). Sized to hold a few windows' live sets
+    mirror_capacity_rows: int = 16384
     # opcode-level trace for ONE block number (debug-trace-at;
     # VM.scala:40-57) — that block runs sequentially with a per-op line
     debug_trace_at: Optional[int] = None

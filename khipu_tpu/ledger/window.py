@@ -27,7 +27,7 @@ from khipu_tpu.base.crypto.keccak import keccak256
 from khipu_tpu.domain.account import address_key
 from khipu_tpu.domain.block_header import BlockHeader
 from khipu_tpu.ledger.world import BlockWorldState
-from khipu_tpu.observability.profiler import HOST, LEDGER
+from khipu_tpu.observability.profiler import H2D, HOST, LEDGER
 from khipu_tpu.observability.trace import event, span
 from khipu_tpu.trie.bulk import Hasher, host_hasher
 from khipu_tpu.trie.deferred import (
@@ -114,10 +114,18 @@ class WindowCommitter:
                  account_start_nonce: int = 0,
                  get_block_hash=None,
                  fused: bool = False,
-                 on_block_committed=None):
+                 on_block_committed=None,
+                 mirror=None):
         self.storages = storages
         self.hasher = hasher
         self.fused = fused  # one-dispatch finalize (trie/fused.py)
+        # device-resident commit target (storage/device_mirror.py):
+        # when set, admit_mirror() lands each sealed window's live
+        # nodes in HBM straight from the fused outputs and persist()
+        # becomes an ASYNC spill — reads of not-yet-spilled nodes are
+        # served by the mirror through NodeStorage's read-through.
+        # None = classic host-commit (persist is the publication point)
+        self.mirror = mirror
         self.account_start_nonce = account_start_nonce
         self.get_block_hash = get_block_hash or (lambda n: None)
         # serving hook (serving/readview.py): called per commit_block
@@ -148,6 +156,11 @@ class WindowCommitter:
         # once N is collected)
         self._inflight_rows: Dict[bytes, Tuple["WindowJob", int]] = {}
         self._inflight_jobs: deque = deque()
+        # windows fully persisted (rows deregistered) whose device
+        # buffers await release. Drained by seal() ON THE DRIVER
+        # THREAD so a release can never race a concurrent
+        # _gather_ext that already holds the job's digest array
+        self._retired: deque = deque()
 
         self._storage_source = _StagedReadThrough(
             storages.storage_node_storage, self._staged,
@@ -235,6 +248,16 @@ class WindowCommitter:
 
     # ------------------------------------------------------ seal/collect
 
+    def drain_retired(self) -> None:
+        """Free the device buffers of windows that fully left the
+        pipeline. Persist only APPENDS to ``_retired`` (it runs on a
+        collector stage thread, where releasing could race a driver
+        seal's d2d gather out of the same digests array); the actual
+        release happens here — on the driver thread, at the next seal
+        or after the final pipeline drain."""
+        while self._retired:
+            self._retired.popleft().fused_job.release()
+
     def seal(self) -> "WindowJob":
         """Close the current window: pack its placeholder DAG and
         DISPATCH the fused fixpoint program (async — the device hashes
@@ -248,6 +271,11 @@ class WindowCommitter:
         tiles (their final digests gathered device-to-device from the
         in-flight job's output — docs/window_pipeline.md), so seals can
         run ``pipeline_depth`` ahead of collects."""
+        # retire windows that left the pipeline since the last seal:
+        # their rows are out of _inflight_rows, so no later seal can
+        # gather from them — drop the digest/encoding device buffers
+        # (HBM stays O(in-flight windows), not O(replayed chain))
+        self.drain_retired()
         start, end = self._window_start, self._counter[0]
         self._window_start = end
         pending, self._pending_blocks = self._pending_blocks, []
@@ -451,52 +479,167 @@ class WindowCommitter:
             tile = jnp.concatenate(parts, axis=0)
         return tile, ext_pos
 
-    def collect(self, job: "WindowJob") -> List[Tuple[BlockHeader, bytes]]:
-        """Wait for a sealed window's digests, CHECK every block root
-        against its header, persist its live nodes + codes, and fold the
-        mapping into the session. Returns [(header, real_root)].
+    def collect_roots(self, job: "WindowJob"
+                      ) -> List[Tuple[BlockHeader, bytes]]:
+        """Stage 1 of the staged collect: CHECK every block root
+        against its header, fetching ONLY the per-block root digests
+        from the device (32 B x blocks via FusedJob.fetch_rows) — not
+        the full digest tile, which stays on device for persist().
+        Returns [(header, real_root)] and marks the job root-checked.
 
-        May run on a background collector thread while the driver seals
-        later windows. The step ORDER below is the thread-safety
-        invariant (every mutation is a GIL-atomic dict/deque op):
-        persist nodes BEFORE publishing their hashes in
-        ``_resolved_global``, publish BEFORE pruning ``_staged``, prune
-        BEFORE dropping the in-flight rows — a racing ``seal`` or
-        ``_StagedReadThrough`` reader always finds each node through at
-        least one of the maps."""
+        May run while the PREVIOUS window is still in persist (its
+        full mapping not yet published): a root ref pointing into it
+        resolves through that job's own fetch_rows via
+        ``_inflight_rows`` — rows are deregistered only at the end of
+        persist, so FIFO stage order guarantees the source is there."""
+        if job.fused_job is not None and job in self._inflight_jobs:
+            for other in self._inflight_jobs:
+                if other is job:
+                    break
+                if not other._roots_checked:
+                    # window N+1's encodings still embed window-N
+                    # placeholder bytes that only resolve once N runs
+                    raise AssertionError(
+                        "collect() out of FIFO order: an earlier "
+                        "sealed window is still in flight"
+                    )
+        resolved_global = self._resolved_global
+        refs = [root_ref for _h, root_ref in job.pending_blocks]
+        if job.mapping is not None:
+            fetched = job.mapping
+        elif job.fused_job is not None:
+            fetched = job.fused_job.fetch_rows(refs)
+        else:
+            fetched = {}
+
+        results: List[Tuple[BlockHeader, bytes]] = []
+        with span("window.rootcheck", blocks=len(job.pending_blocks)):
+            for header, root_ref in job.pending_blocks:
+                real = fetched.get(root_ref) or resolved_global.get(
+                    root_ref
+                )
+                if real is None:
+                    # an earlier window mid-persist: fetch its digest
+                    # row straight off the device
+                    src = self._inflight_rows.get(root_ref)
+                    if src is not None:
+                        real = src[0].fused_job.fetch_rows(
+                            [root_ref]
+                        ).get(root_ref)
+                if real is None:
+                    real = root_ref
+                if real != header.state_root:
+                    raise WindowMismatch(
+                        header.number, real, header.state_root
+                    )
+                results.append((header, real))
+        job.results = results
+        job._roots_checked = True
+        return results
+
+    def admit_mirror(self, job: "WindowJob") -> None:
+        """Stage-1 second half: land the window's LIVE nodes in the
+        device mirror straight from the fused outputs — encodings
+        gathered d2d from the FINAL substituted buffers, claimed
+        digests d2d from the digest tile; only the int32 row-index
+        array crosses the tunnel. Rows are keyed by the window's
+        placeholder ALIASES (real digests are still on device) and
+        persist() rekeys them once the mapping lands on host.
+        No-op without a mirror or on the host-hasher path."""
+        fj = job.fused_job
+        mirror = self.mirror
+        if mirror is None or fj is None or fj.encs is None:
+            if fj is not None:
+                fj.release_encs()
+            return
+        import numpy as np
+        import jax.numpy as jnp
+
+        from khipu_tpu.ops.keccak_jnp import RATE
+        from khipu_tpu.storage.device_mirror import TILE
+
+        live = job.live
+        aliases: List[bytes] = []
+        with span("window.admit", live=len(live)):
+            for c, (phs, base) in enumerate(fj.class_rows):
+                enc_dev = fj.encs[c]
+                nb = int(enc_dev.shape[1]) // RATE
+                idx: List[int] = []
+                keys: List[Optional[bytes]] = []
+                lengths: List[int] = []
+                for r, ph in enumerate(phs):
+                    if ph in live:
+                        idx.append(r)
+                        keys.append(ph)
+                        lengths.append(len(job.to_resolve[ph]))
+                if not idx:
+                    continue
+                n = len(idx)
+                npad = -(-n // TILE) * TILE
+                # gather padding points at the class's guaranteed
+                # padding row: its final encoding is a valid multi-
+                # rate-padded row and digests[base+dummy] is its
+                # self-consistent digest, so filler slots verify
+                dummy = int(enc_dev.shape[0]) - 1
+                idx_np = np.full(npad, dummy, dtype=np.int32)
+                idx_np[:n] = idx
+                keys.extend([None] * (npad - n))
+                lengths.extend([0] * (npad - n))
+                with LEDGER.transfer(
+                    "mirror.admit_window", H2D, idx_np.nbytes
+                ):
+                    idx_dev = jnp.asarray(idx_np)
+                enc_g = enc_dev[idx_dev]  # d2d
+                claim_g = fj.digests[base + idx_dev]  # d2d
+                mirror.admit_device(nb, keys, enc_g, claim_g, lengths)
+                aliases.extend(k for k in keys if k is not None)
+        job.aliases = aliases
+        fj.release_encs()
+
+    def persist(self, job: "WindowJob") -> None:
+        """Stage 2: fetch the window's full mapping (the one remaining
+        bulk d2h, now OFF the critical path), publish it, spill the
+        substituted encodings to host storage, prune session state.
+
+        May run on a background stage thread while the driver seals
+        later windows and the collect stage root-checks the next
+        window. The step ORDER below is the thread-safety invariant
+        (every mutation is a GIL-atomic dict/deque op). WITH a mirror:
+        rekey the device rows to their real hashes FIRST, then publish
+        ``_resolved_global`` — a reader following a published hash
+        finds the node in the mirror even before the host spill lands
+        (NodeStorage read-through). WITHOUT a mirror: spill BEFORE
+        publishing, publish BEFORE pruning ``_staged``, prune BEFORE
+        dropping the in-flight rows — a racing ``seal`` or
+        ``_StagedReadThrough`` reader always finds each node through
+        at least one of the maps."""
         if job.fused_job is not None and self._inflight_jobs:
             if (self._inflight_jobs[0] is not job
                     and job in self._inflight_jobs):
-                # window N+1's encodings still embed window-N
-                # placeholder bytes that only resolve once N publishes
                 raise AssertionError(
-                    "collect() out of FIFO order: an earlier sealed "
+                    "persist() out of FIFO order: an earlier sealed "
                     "window is still in flight"
                 )
         mapping = job.mapping
         if mapping is None:
             mapping = job.fused_job.collect()
         resolved_global = self._resolved_global
-
-        results: List[Tuple[BlockHeader, bytes]] = []
-        with span("window.rootcheck", blocks=len(job.pending_blocks)):
-            for header, root_ref in job.pending_blocks:
-                real = mapping.get(root_ref) or resolved_global.get(
-                    root_ref, root_ref
+        published = False
+        if job.aliases:
+            with span("window.rekey", rows=len(job.aliases)):
+                self.mirror.rekey(
+                    {a: mapping[a] for a in job.aliases if a in mapping}
                 )
-                if real != header.state_root:
-                    raise WindowMismatch(
-                        header.number, real, header.state_root
-                    )
-                results.append((header, real))
+            resolved_global.update(mapping)
+            published = True
 
-        # persist LIVE nodes only (dead intermediates were hashed for the
+        # spill LIVE nodes only (dead intermediates were hashed for the
         # root checks but nothing references them), routed by session
         # tag. Substitution is ONE vectorized pass over the joined
         # encodings (numpy prefix scan) instead of a Python scan per
         # node — collect was 46% of replay wall clock (BENCH_r05).
         # Cross-window refs resolve through resolved_global: FIFO
-        # collect order guarantees the source window published first.
+        # persist order guarantees the source window published first.
         live_phs: List[bytes] = []
         reals: List[bytes] = []
         encs: List[bytes] = []
@@ -515,6 +658,8 @@ class WindowCommitter:
             v = _m.get(ref)
             return v if v is not None else _g.get(ref)
 
+        from khipu_tpu.chaos import fault_point
+
         with span("window.store", live=len(live_phs)):
             subbed = _substitute_many(encs, _lookup)
             account_nodes: Dict[bytes, bytes] = {}
@@ -527,6 +672,11 @@ class WindowCommitter:
                     account_nodes[real] = enc
             t_store = time.perf_counter()
             self.storages.account_node_storage.update([], account_nodes)
+            # chaos seam: a `die` here kills the spill between the two
+            # node stores — the torn window must roll back bit-exact
+            # through journal.recover() (host state has the account
+            # half only; the mirror is volatile and detached there)
+            fault_point("collector.spill")
             self.storages.storage_node_storage.update([], storage_nodes)
             if LEDGER.enabled:
                 # host-side store traffic: classification only (HOST
@@ -543,12 +693,13 @@ class WindowCommitter:
             code = staged_codes.pop(code_hash, None)
             if code is not None:
                 self.storages.evmcode_storage.put(code_hash, code)
-        resolved_global.update(mapping)
-        # prune the collected window's staged encodings: the live nodes
-        # are persisted and retained trie refs read through the
-        # resolved mapping (_StagedReadThrough); dead ones are
-        # unreferenced — keeps session memory ~O(open windows), not
-        # O(replayed chain)
+        if not published:
+            resolved_global.update(mapping)
+        # prune the persisted window's staged encodings: the live nodes
+        # are durable (or mirror-resident) and retained trie refs read
+        # through the resolved mapping (_StagedReadThrough); dead ones
+        # are unreferenced — keeps session memory ~O(open windows),
+        # not O(replayed chain)
         staged = self._staged
         for ph in job.to_resolve:
             staged.pop(ph, None)
@@ -561,6 +712,20 @@ class WindowCommitter:
                 inflight.pop(ph, None)
             if self._inflight_jobs and self._inflight_jobs[0] is job:
                 self._inflight_jobs.popleft()
+            # device buffers released by the NEXT seal on the driver
+            # thread (see __init__._retired) — never here, where a
+            # concurrent _gather_ext may hold the digest array
+            self._retired.append(job)
+
+    def collect(self, job: "WindowJob") -> List[Tuple[BlockHeader, bytes]]:
+        """Root-check + mirror-admit + persist in one call — the
+        synchronous composition the non-staged paths (finalize, the
+        degraded collector, direct tests) use. The staged pipeline in
+        sync/replay.py calls the three stages separately so the bulk
+        d2h of persist() overlaps the next window's root checks."""
+        results = self.collect_roots(job)
+        self.admit_mirror(job)
+        self.persist(job)
         return results
 
     # ---------------------------------------------------------- finalize
@@ -580,7 +745,8 @@ class WindowJob:
     either an async FusedJob (device) or an eager mapping (host)."""
 
     __slots__ = ("committer", "pending_blocks", "to_resolve", "live",
-                 "fused_job", "mapping", "codes")
+                 "fused_job", "mapping", "codes", "results", "aliases",
+                 "_roots_checked")
 
     def __init__(self, committer, pending_blocks, to_resolve, live):
         self.committer = committer
@@ -590,3 +756,7 @@ class WindowJob:
         self.fused_job = None
         self.mapping: Optional[Dict[bytes, bytes]] = None
         self.codes: List[bytes] = []
+        # set by collect_roots / admit_mirror (staged collect)
+        self.results: Optional[List[Tuple[BlockHeader, bytes]]] = None
+        self.aliases: List[bytes] = []
+        self._roots_checked = False

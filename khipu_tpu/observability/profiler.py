@@ -54,6 +54,7 @@ __all__ = [
     "TransferLedger",
     "LEDGER",
     "COLLECT_CLASSES",
+    "KNOWN_SITES",
 ]
 
 H2D = "h2d"  # host -> device upload
@@ -64,10 +65,33 @@ HOST = "host"  # host-side persistence traffic (classification only)
 # khipu_window_report(n) serves so "collect is slow" decomposes into
 # hauling digests back (placeholder-resolution) vs writing the node
 # store vs saving blocks (docs/roofline.md "the tunnel tax, revisited")
+# every site string the runtime meters — THE canonical registry the
+# khipu-lint KL001 rule validates ``with *.transfer("site", ...)``
+# spellings against (a misspelled site silently forks a new series in
+# khipu_device_transfer_* and vanishes from its COLLECT_CLASSES
+# stream). Adding an instrumentation seam means adding its site HERE.
+KNOWN_SITES = frozenset({
+    # fused fixpoint hasher (trie/fused.py)
+    "fused.dispatch", "fused.collect", "fused.rootcheck",
+    # device-resident node mirror (storage/device_mirror.py)
+    "mirror.init", "mirror.claim", "mirror.admit",
+    "mirror.admit_window", "mirror.get", "mirror.verify",
+    # window commit + block persistence (ledger/window.py, sync/replay.py)
+    "window.store", "block.save",
+    # sharded multi-device paths (parallel/)
+    "shard.dispatch", "shard.gather", "shard.keccak", "shard.verify",
+    # raw keccak ops (ops/)
+    "ops.keccak",
+    # bench/metrics self-checks
+    "bench.smoke",
+})
+
 COLLECT_CLASSES = {
     "fused.collect": "placeholder-resolution",
+    "fused.rootcheck": "placeholder-resolution",
     "mirror.get": "placeholder-resolution",
     "shard.gather": "placeholder-resolution",
+    "mirror.admit_window": "mirror-admit",
     "window.store": "store-write",
     "block.save": "block-save",
 }
@@ -437,6 +461,15 @@ def _ledger_samples():
                 "khipu_device_transfer_bytes_per_block", "gauge",
                 {"direction": direction}, nbytes // LEDGER.blocks,
             ))
+        # the device-resident-commit headline: with the mirror owning
+        # the commit, the collect stage should fetch only per-block
+        # root digests (32 B/block) — this gauge near zero IS the
+        # "collect wall broken" signal the bench smoke pins
+        samples.append((
+            "khipu_collect_d2h_bytes_per_block", "gauge", {},
+            LEDGER.phase_bytes_per_block()
+            .get("collect", {}).get(D2H, 0),
+        ))
     return samples
 
 

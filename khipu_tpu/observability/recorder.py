@@ -4,7 +4,8 @@ trace.py records flat spans; this module turns a snapshot of them into
 
 * per-block LIFECYCLE records — every phase a block passed through
   (announce -> import -> window.build -> window.seal [-> fused.dispatch]
-  -> window.collect -> window.persist), each with wall interval, thread
+  -> window.collect -> window.persist -> window.save), each with wall
+  interval, thread
   and parent link, so ``khipu_trace_block(n)`` answers "where did block
   n spend its time" across the driver/collector boundary;
 * a pipeline-occupancy TIMELINE — driver-busy vs collector-busy
@@ -37,20 +38,24 @@ PHASE_SEAL = "window.seal"
 PHASE_DISPATCH = "fused.dispatch"
 PHASE_COLLECT = "window.collect"
 PHASE_PERSIST = "window.persist"
+PHASE_SAVE = "window.save"
 PHASE_STALL = "pipeline.stall"
 
 LIFECYCLE_PHASES = (
     PHASE_ANNOUNCE, PHASE_IMPORT, PHASE_BUILD, PHASE_SEAL,
-    PHASE_DISPATCH, PHASE_COLLECT, PHASE_PERSIST,
+    PHASE_DISPATCH, PHASE_COLLECT, PHASE_PERSIST, PHASE_SAVE,
 )
 # phases a windowed-replay block must traverse for its record to be
 # "complete" (announce/import appear only on the live-sync path;
 # fused.dispatch only under device commit)
-REQUIRED_PHASES = (PHASE_BUILD, PHASE_SEAL, PHASE_COLLECT, PHASE_PERSIST)
+REQUIRED_PHASES = (PHASE_BUILD, PHASE_SEAL, PHASE_COLLECT, PHASE_PERSIST,
+                   PHASE_SAVE)
 
 DRIVER_PHASES = (PHASE_ANNOUNCE, PHASE_IMPORT, PHASE_BUILD, PHASE_SEAL,
                  PHASE_STALL)
-COLLECTOR_PHASES = (PHASE_COLLECT, PHASE_PERSIST)
+# the three collector stage threads (sync/replay.py staged pipeline):
+# rootcheck+mirror-admit, host spill, block save
+COLLECTOR_PHASES = (PHASE_COLLECT, PHASE_PERSIST, PHASE_SAVE)
 
 
 def spans_for_block(spans: Iterable[Span], number: int) -> List[Span]:

@@ -24,6 +24,13 @@ class NodeStorage:
         self._unconfirmed = SimpleMapWithUnconfirmed(source, depth)
         self._unconfirmed.set_buffering(False)  # regular-sync switch turns on
         self._cache: FIFOCache = FIFOCache(cache_size)
+        # device-resident read-through (storage/device_mirror.py): when
+        # the window commit targets the device mirror, freshly committed
+        # nodes live ONLY there until the async spill stage writes them
+        # here. Attached by the replay driver; None = host-only reads.
+        # Never cached on hit: the mirror ring-evicts, and the spill
+        # lands the durable copy in the host store shortly after.
+        self.mirror = None
 
     def get(self, key: bytes) -> Optional[bytes]:
         v = self._cache.get(key)
@@ -32,7 +39,11 @@ class NodeStorage:
         v = self._unconfirmed.get(key)
         if v is not None:
             self._cache.put(key, v)
-        return v
+            return v
+        m = self.mirror
+        if m is not None:
+            return m.get(key)
+        return None
 
     def put(self, key: bytes, value: bytes) -> None:
         self.update([], {key: value})

@@ -111,6 +111,21 @@ class Storages:
             self.receipts_storage.best_block_number,
         )
 
+    def attach_mirror(self, mirror) -> None:
+        """Route trie-node read misses through the device mirror
+        (device-resident window commit: nodes are readable from HBM
+        before the async spill lands them in the host store). evmcode
+        is excluded — code bytes never enter the fused hash path."""
+        self.account_node_storage.mirror = mirror
+        self.storage_node_storage.mirror = mirror
+
+    def detach_mirror(self) -> None:
+        """Drop the device read-through (recovery: the mirror is
+        volatile, so crash verification must see host-durable state
+        only — exactly what a real restart would see)."""
+        self.account_node_storage.mirror = None
+        self.storage_node_storage.mirror = None
+
     def switch_to_unconfirmed(self) -> None:
         for s in self._node_storages:
             s.switch_to_unconfirmed()

@@ -218,6 +218,12 @@ def recover(blockchain, log: Optional[Callable[[str], None]] = None
     state fully verifies. Idempotent: a crash DURING recovery re-enters
     the same scan."""
     storages = blockchain.storages
+    # the device mirror is volatile: recovery verification must see
+    # exactly what a real restart would see — host-durable state only.
+    # (In-process crash tests would otherwise "recover" through HBM.)
+    detach = getattr(storages, "detach_mirror", None)
+    if detach is not None:
+        detach()
     journal = storages.window_journal
     report = RecoveryReport(best_before=storages.app_state.best_block_number)
     pending = journal.pending()

@@ -48,15 +48,23 @@ from khipu_tpu.validators.validators import (
 # both update them in place exactly as the plain dict allowed.
 PIPELINE_GAUGES = REGISTRY.gauge_group("khipu_pipeline", {
     "depth": 0,  # configured pipeline_depth of the last run
-    "in_flight": 0,  # windows sealed but not yet collected
+    "in_flight": 0,  # windows sealed but not yet fully saved
     "windows_sealed": 0,
     "windows_collected": 0,
     "occupancy": 0.0,  # driver/collector overlap fraction, last run
     "driver_stall_s": 0.0,  # driver seconds blocked on backpressure
-    "collector_busy_s": 0.0,  # background collect+save busy seconds
+    "collector_busy_s": 0.0,  # background stage busy seconds (all)
     "collector_deaths": 0,  # dead workers detected by liveness checks
     "sync_fallback_windows": 0,  # windows committed synchronously after
     # a collector death (graceful degradation — docs/recovery.md)
+    # per-stage occupancy/depth of the staged collector pipeline
+    # (collect -> persist -> save; docs/window_pipeline.md)
+    "stage_collect_depth": 0,
+    "stage_persist_depth": 0,
+    "stage_save_depth": 0,
+    "stage_collect_busy_s": 0.0,
+    "stage_persist_busy_s": 0.0,
+    "stage_save_busy_s": 0.0,
 }, help="window-pipeline state (sync/replay.py)")
 
 
@@ -80,9 +88,10 @@ class ReplayStats:
     # / commit / seal / collect / save — the breakdown that names the
     # next bottleneck instead of guessing it. Under the deep pipeline
     # `collect`/`save` are DRIVER-THREAD STALL (backpressure + drains);
-    # the background collector's busy time lands in `collect_bg` /
-    # `save_bg` (it overlaps execute, so adding it to wall clock would
-    # double-count)
+    # the staged collector's busy time lands in `collect_bg` (root
+    # checks + mirror admit) / `persist_bg` (async host spill) /
+    # `save_bg` (block saves) — those overlap execute, so adding them
+    # to wall clock would double-count
     phases: dict = field(default_factory=dict)
     # fraction of the collector's busy time that overlapped driver work
     # (1.0 = collect/save fully hidden behind execution)
@@ -97,84 +106,129 @@ class ReplayStats:
 
 
 class _WindowCollector:
-    """Bounded background collector: root checks + live-node/code
-    persistence + block saves run HERE while the driver executes the
-    next window's transactions. ``submit`` enqueues one collect+save
-    closure and blocks only while ``depth`` jobs are already queued or
-    running (backpressure); ``drain`` blocks until the pipeline is
-    empty. Jobs run strictly FIFO on one thread — block saves chain
-    total difficulty, and window N+1's encodings resolve through
-    window N's published hashes (ledger/window.collect docstring).
+    """Staged background collector pipeline: each window job flows
+    through up to three bounded FIFO stages on dedicated threads —
+    **collect** (root checks + d2d mirror admit), **persist** (async
+    host spill of the window's nodes), **save** (block storage) — while
+    the driver executes the next window's transactions. ``submit``
+    enqueues one job (a single callable, or a tuple of per-stage
+    callables) and blocks only while ``depth`` jobs already occupy the
+    first stage (backpressure); stage hand-offs are bounded the same
+    way; ``drain`` blocks until every stage is empty. Within a stage
+    jobs run strictly FIFO — block saves chain total difficulty, and
+    window N+1's encodings resolve through window N's published hashes
+    (ledger/window.persist docstring) — and a job cannot overtake
+    another across stages because hand-off order preserves queue order.
 
     Failure semantics: the FIRST exception (typically WindowMismatch)
-    aborts the pipeline — queued jobs are dropped WITHOUT persisting
-    anything and the original exception object re-raises on the driver
-    thread at its next submit/drain, so a mismatch still names the
-    failing block number."""
+    aborts the whole pipeline — queued jobs at EVERY stage are dropped
+    WITHOUT persisting anything and the original exception object
+    re-raises on the driver thread at its next submit/drain, so a
+    mismatch still names the failing block number."""
+
+    STAGES = ("collect", "persist", "save")
 
     def __init__(self, depth: int, join_timeout: float = 60.0,
                  liveness_poll: float = 0.1):
         self.depth = max(1, depth)
-        self.busy_seconds = 0.0
         self.join_timeout = join_timeout
         # backpressure/drain waits wake at this period to re-check the
-        # worker is still alive — a dead thread can never notify, so an
-        # untimed wait would hang the driver forever
+        # workers are still alive — a dead thread can never notify, so
+        # an untimed wait would hang the driver forever
         self.liveness_poll = liveness_poll
         self._cv = threading.Condition()
-        self._q: deque = deque()
-        self._active = False
-        self._current: Optional[Callable[[], None]] = None
+        k = len(self.STAGES)
+        self._qs: List[deque] = [deque() for _ in range(k)]
+        self._active: List[bool] = [False] * k
+        self._current: List[Optional[tuple]] = [None] * k
+        self._done: List[bool] = [False] * k  # normal thread exit
+        self.stage_busy: List[float] = [0.0] * k
         self._failure: Optional[BaseException] = None
         self._closed = False
-        self._thread = threading.Thread(
-            target=self._run, name="window-collector", daemon=True
-        )
-        self._thread.start()
+        self._inflight = 0  # jobs submitted but not fully completed
+        self._threads = [
+            threading.Thread(
+                target=self._run, args=(i,),
+                name=f"window-{name}", daemon=True,
+            )
+            for i, name in enumerate(self.STAGES)
+        ]
+        for t in self._threads:
+            t.start()
+
+    @property
+    def _thread(self) -> threading.Thread:
+        """The first-stage thread — the legacy single-worker handle
+        (tests and external liveness probes join/poll it)."""
+        return self._threads[0]
+
+    @property
+    def busy_seconds(self) -> float:
+        return sum(self.stage_busy)
 
     # ------------------------------------------------------- driver side
 
-    def _check_liveness(self) -> None:
-        """Call under ``_cv``. A worker that exited without recording a
-        failure and without being closed died mid-job (chaos ``die`` or
-        a real interpreter-level death) — raise instead of waiting on
-        notifies that will never come."""
-        if (self._failure is None and not self._closed
-                and not self._thread.is_alive()):
-            raise CollectorDied(
-                "window-collector thread died mid-job "
-                f"({len(self._q)} queued, active={self._active})"
+    def _update_gauges(self) -> None:
+        """Call under ``_cv``."""
+        PIPELINE_GAUGES["in_flight"] = self._inflight
+        for i, name in enumerate(self.STAGES):
+            PIPELINE_GAUGES[f"stage_{name}_depth"] = (
+                len(self._qs[i]) + (1 if self._active[i] else 0)
+            )
+            PIPELINE_GAUGES[f"stage_{name}_busy_s"] = round(
+                self.stage_busy[i], 3
             )
 
-    def submit(self, fn: Callable[[], None]) -> float:
-        """Queue one job; returns driver seconds stalled on
-        backpressure. Re-raises the collector's failure, if any;
-        raises CollectorDied when the worker is gone."""
+    def _check_liveness(self) -> None:
+        """Call under ``_cv``. A stage worker that exited without
+        recording a failure, without being closed, died mid-job (chaos
+        ``die`` or a real interpreter-level death) — raise instead of
+        waiting on notifies that will never come."""
+        if self._failure is not None or self._closed:
+            return
+        for i, t in enumerate(self._threads):
+            if not self._done[i] and not t.is_alive():
+                raise CollectorDied(
+                    f"window-{self.STAGES[i]} stage thread died mid-"
+                    f"job ({sum(len(q) for q in self._qs)} queued, "
+                    f"active={self._active})"
+                )
+
+    def submit(self, fns) -> float:
+        """Queue one job: a bare callable (runs entirely on the first
+        stage) or a tuple of per-stage callables — stage i runs
+        ``fns[i]`` then hands the job to stage i+1; the job completes
+        at its last callable. Returns driver seconds stalled on
+        first-stage backpressure. Re-raises the collector's failure,
+        if any; raises CollectorDied when a worker is gone."""
+        fns = (fns,) if callable(fns) else tuple(fns)
         t0 = time.perf_counter()
         with self._cv:
             self._check_liveness()
             while (self._failure is None and not self._closed
-                   and len(self._q) + self._active >= self.depth):
+                   and len(self._qs[0]) + self._active[0] >= self.depth):
                 self._cv.wait(timeout=self.liveness_poll)
                 self._check_liveness()
             if self._failure is not None:
                 raise self._failure
             if self._closed:
                 raise RuntimeError("collector is closed")
-            self._q.append(fn)
+            self._qs[0].append(fns)
+            self._inflight += 1
             PIPELINE_GAUGES["windows_sealed"] += 1
-            PIPELINE_GAUGES["in_flight"] = len(self._q) + self._active
+            self._update_gauges()
             self._cv.notify_all()
         return time.perf_counter() - t0
 
     def drain(self) -> float:
-        """Wait until every queued job has completed; returns driver
-        seconds stalled. Re-raises the collector's failure, if any;
-        raises CollectorDied when the worker is gone."""
+        """Wait until every submitted job has fully completed (all
+        stages); returns driver seconds stalled. Re-raises the
+        collector's failure, if any; raises CollectorDied when a
+        worker is gone."""
         t0 = time.perf_counter()
         with self._cv:
             self._check_liveness()
-            while self._failure is None and (self._q or self._active):
+            while self._failure is None and self._inflight:
                 self._cv.wait(timeout=self.liveness_poll)
                 self._check_liveness()
             if self._failure is not None:
@@ -182,32 +236,54 @@ class _WindowCollector:
         return time.perf_counter() - t0
 
     def take_pending(self) -> List[Callable[[], None]]:
-        """After CollectorDied: the dead worker's unfinished jobs in
-        FIFO order — the partially-executed current job FIRST (jobs are
+        """After CollectorDied: every unfinished job in FIFO order —
+        deepest stage first (those windows are oldest), each stage's
+        partially-executed current job ahead of its queue (jobs are
         idempotent: node puts are content-addressed, block saves
-        overwrite by number, stats apply only at job end). Marks the
-        collector closed; the caller runs these synchronously."""
+        overwrite by number, stats apply only at job end). A job with
+        several stages left comes back as one closure running them in
+        order; a job with ONE stage left comes back as that bare
+        callable. Marks the collector closed; the caller runs these
+        synchronously."""
         with self._cv:
-            fns: List[Callable[[], None]] = []
-            if self._active and self._current is not None:
-                fns.append(self._current)
-            fns.extend(self._q)
-            self._q.clear()
+            out: List[Callable[[], None]] = []
+            for i in range(len(self.STAGES) - 1, -1, -1):
+                entries: List[tuple] = []
+                if self._active[i] and self._current[i] is not None:
+                    entries.append(self._current[i])
+                entries.extend(self._qs[i])
+                self._qs[i].clear()
+                out.extend(self._resume(fns, i) for fns in entries)
             self._closed = True
-            PIPELINE_GAUGES["in_flight"] = 0
+            self._inflight = 0
+            self._update_gauges()
             self._cv.notify_all()
-        return fns
+        return out
+
+    @staticmethod
+    def _resume(fns: tuple, i: int) -> Callable[[], None]:
+        rest = fns[i:]
+        if len(rest) == 1:
+            return rest[0]
+
+        def run_rest():
+            for fn in rest:
+                fn()
+
+        return run_rest
 
     def close(self) -> None:
-        """Stop the worker (after finishing anything queued) and join.
-        Safe to call twice. Raises if the worker is still alive after
+        """Stop the workers (after finishing anything queued) and join.
+        Safe to call twice. Raises if any worker is still alive after
         ``join_timeout`` — a wedged job must not be silently abandoned
         with the pipeline's windows unaccounted for."""
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        self._thread.join(timeout=self.join_timeout)
-        if self._thread.is_alive():
+        deadline = time.monotonic() + self.join_timeout
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if any(t.is_alive() for t in self._threads):
             raise RuntimeError(
                 "window-collector failed to stop within "
                 f"{self.join_timeout:.0f}s — a wedged job is still "
@@ -215,17 +291,22 @@ class _WindowCollector:
             )
 
     def kill(self) -> None:
-        """Abort: drop queued jobs WITHOUT running them (nothing else
-        persists) and join. The driver calls this when IT failed —
-        windows sealed after the failing block must not be committed.
-        Already unwinding, so a wedged worker is logged loudly instead
-        of raised over the original failure."""
+        """Abort: drop queued jobs at every stage WITHOUT running them
+        (nothing else persists) and join. The driver calls this when IT
+        failed — windows sealed after the failing block must not be
+        committed. Already unwinding, so a wedged worker is logged
+        loudly instead of raised over the original failure."""
         with self._cv:
-            self._q.clear()
+            for q in self._qs:
+                q.clear()
             self._closed = True
+            self._inflight = 0
+            self._update_gauges()
             self._cv.notify_all()
-        self._thread.join(timeout=self.join_timeout)
-        if self._thread.is_alive():
+        deadline = time.monotonic() + self.join_timeout
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if any(t.is_alive() for t in self._threads):
             import sys
 
             print(
@@ -237,23 +318,38 @@ class _WindowCollector:
 
     # ------------------------------------------------------- worker side
 
-    def _run(self) -> None:
+    def _exit_ready(self, i: int) -> bool:
+        """Call under ``_cv``: stage ``i`` may exit once the collector
+        is closed and its upstream can never forward again — exited
+        normally, or died mid-job (its torn job is take_pending's to
+        re-run, never forwarded)."""
+        if not self._closed:
+            return False
+        if i == 0:
+            return True
+        return self._done[i - 1] or not self._threads[i - 1].is_alive()
+
+    def _run(self, i: int) -> None:
+        q = self._qs[i]
         while True:
             with self._cv:
-                while (not self._q and not self._closed
-                       and self._failure is None):
-                    self._cv.wait()
+                while (not q and self._failure is None
+                       and not self._exit_ready(i)):
+                    # timed: an upstream death is silent (no notify)
+                    self._cv.wait(timeout=0.5)
                 if self._failure is not None or (
-                    self._closed and not self._q
+                    not q and self._exit_ready(i)
                 ):
+                    self._done[i] = True
+                    self._cv.notify_all()
                     return
-                fn = self._q.popleft()
-                self._current = fn
-                self._active = True
-                PIPELINE_GAUGES["in_flight"] = len(self._q) + 1
+                fns = q.popleft()
+                self._current[i] = fns
+                self._active[i] = True
+                self._update_gauges()
             t0 = time.perf_counter()
             try:
-                fn()
+                fns[i]()
             except InjectedDeath:
                 # simulated process death (chaos `die`): no failure
                 # record, no notify — the thread just stops with the
@@ -269,20 +365,37 @@ class _WindowCollector:
             except BaseException as exc:  # surfaces on the driver
                 with self._cv:
                     self._failure = exc
-                    self._active = False
-                    self._current = None
-                    self._q.clear()  # abort: NOTHING else persists
-                    PIPELINE_GAUGES["in_flight"] = 0
+                    self._active[i] = False
+                    self._current[i] = None
+                    for qq in self._qs:
+                        qq.clear()  # abort: NOTHING else persists
+                    self._inflight = 0
+                    self._update_gauges()
                     self._cv.notify_all()
                 return
             dt = time.perf_counter() - t0
+            forward = len(fns) > i + 1 and i + 1 < len(self._qs)
             with self._cv:
-                self.busy_seconds += dt
-                self._active = False
-                self._current = None
-                PIPELINE_GAUGES["windows_collected"] += 1
-                PIPELINE_GAUGES["in_flight"] = len(self._q)
-                PIPELINE_GAUGES["collector_busy_s"] = self.busy_seconds
+                self.stage_busy[i] += dt
+                PIPELINE_GAUGES["collector_busy_s"] = round(
+                    self.busy_seconds, 3
+                )
+                if forward:
+                    # bounded hand-off: wait while downstream is full
+                    # (close() still forwards — queued work must
+                    # complete; only kill()/failure drop it)
+                    while (len(self._qs[i + 1]) >= self.depth
+                           and self._failure is None
+                           and not self._closed):
+                        self._cv.wait(timeout=self.liveness_poll)
+                    if self._failure is None:
+                        self._qs[i + 1].append(fns)
+                else:
+                    self._inflight = max(0, self._inflight - 1)
+                    PIPELINE_GAUGES["windows_collected"] += 1
+                self._active[i] = False
+                self._current[i] = None
+                self._update_gauges()
                 self._cv.notify_all()
 
 
@@ -334,6 +447,11 @@ class ReplayDriver:
             self.hasher = device_hasher
         else:
             self.hasher = None
+        # lazy per-driver device mirror (the window-commit target when
+        # sync.device_mirror_commit is on); built on first windowed
+        # replay so chaos configs that never reach a fused dispatch
+        # pay no device setup
+        self._mirror = None
 
     def recover(self):
         """Crash-recovery startup pass (sync/journal.py): settle every
@@ -391,7 +509,8 @@ class ReplayDriver:
         stats = ReplayStats()
         ph = stats.phases
         for k in ("senders", "validate", "execute", "commit", "seal",
-                  "collect", "save", "collect_bg", "save_bg"):
+                  "collect", "save", "collect_bg", "persist_bg",
+                  "save_bg"):
             ph[k] = 0.0
         t_start = time.perf_counter()
         hasher = self.hasher or host_hasher
@@ -410,6 +529,26 @@ class ReplayDriver:
             h = window_headers.get(n)
             return h if h else self.blockchain.get_hash_by_number(n)
 
+        # device-resident commit (docs/window_pipeline.md): on the
+        # fused device path the store's mirror becomes the commit
+        # target — collect admits windows d2d and the host spill runs
+        # async on the persist stage; NodeStorage read-through serves
+        # not-yet-spilled nodes. One mirror per driver, reused across
+        # epochs/replays (its XLA kernels are process-cached anyway)
+        mirror = None
+        if (self.hasher is not None
+                and self.config.sync.device_mirror_commit):
+            mirror = self._mirror
+            if mirror is None:
+                from khipu_tpu.storage.device_mirror import (
+                    DeviceNodeMirror,
+                )
+
+                mirror = self._mirror = DeviceNodeMirror(
+                    self.config.sync.mirror_capacity_rows
+                )
+            self.blockchain.storages.attach_mirror(mirror)
+
         def make_committer(parent_root: bytes) -> WindowCommitter:
             return WindowCommitter(
                 self.blockchain.storages,
@@ -427,6 +566,7 @@ class ReplayDriver:
                     self.read_view.publish_block
                     if self.read_view is not None else None
                 ),
+                mirror=mirror,
             )
 
         committer = make_committer(parent.state_root)
@@ -463,21 +603,23 @@ class ReplayDriver:
                 PIPELINE_GAUGES["sync_fallback_windows"] += 1
                 fn()
 
-        def submit_job(run_fn) -> float:
+        def submit_job(run_fns) -> float:
             if sync_degraded:
                 PIPELINE_GAUGES["sync_fallback_windows"] += 1
-                run_fn()
+                for fn in run_fns:
+                    fn()
                 if journal is not None:
                     journal.prune()
                 return 0.0
             try:
-                return collector.submit(run_fn)
+                return collector.submit(run_fns)
             except CollectorDied:
                 if not degrade_on_death:
                     raise
                 _degrade()
                 PIPELINE_GAUGES["sync_fallback_windows"] += 1
-                run_fn()
+                for fn in run_fns:
+                    fn()
                 return 0.0
 
         def drain_pipeline() -> float:
@@ -507,98 +649,117 @@ class ReplayDriver:
         epoch = self.session_epoch_blocks
         blocks_since_reset = 0
 
-        def make_collect_job(cm: WindowCommitter, job, results, seal_tok,
-                             intent_seq):
-            # runs ON THE COLLECTOR THREAD, strictly FIFO. ``seal_tok``
-            # (the driver's window.seal span id) rides the closure across
-            # the queue so the trace links the collector's spans to the
-            # seal that produced them (the cross-thread parent edge —
-            # flow arrows in the Chrome dump)
+        def make_stage_jobs(cm: WindowCommitter, job, results, seal_tok,
+                            intent_seq):
+            # the three per-stage closures one window job flows
+            # through, each ON ITS OWN COLLECTOR STAGE THREAD,
+            # strictly FIFO within a stage. ``seal_tok`` (the driver's
+            # window.seal span id) rides the closures across the
+            # queues so the trace links the stages' spans to the seal
+            # that produced them (the cross-thread parent edge — flow
+            # arrows in the Chrome dump). The driver's tracer rides
+            # the same way: stage threads have no thread-local binding
+            # of their own, and falling back to the module default
+            # would split one driver's trace across two rings.
             lo, hi = results[0][0].number, results[-1][0].number
             tr = self.tracer
 
-            def run():
-                # the driver's tracer rides the closure: the collector
-                # thread has no thread-local binding of its own, and
-                # falling back to the module default would split one
-                # driver's trace across two rings
-                with use_tracer(tr):
-                    _run()
-
-            def _run():
+            def collect_fn():
                 # chaos seams: a rule at any of the collector.* sites
                 # models a failure/death at that phase of the job
                 # (docs/recovery.md crash-point table)
-                fault_point("collector.collect")
-                t0 = time.perf_counter()
-                with span("window.collect", parent=seal_tok,
-                          block_lo=lo, block_hi=hi), \
-                        LEDGER.context(window=lo, phase="collect"):
-                    cm.collect(job)  # raises WindowMismatch on divergence
-                t1 = time.perf_counter()
-                fault_point("collector.persist")
-                blocks = txs = gas = ptxs = confl = 0
-                with span("window.persist", parent=seal_tok,
-                          block_lo=lo, block_hi=hi, blocks=len(results)), \
-                        LEDGER.context(window=lo, phase="persist"):
-                    for block, result in results:
-                        td = (
-                            self.blockchain.get_total_difficulty(
-                                block.number - 1
-                            )
-                            or 0
-                        ) + block.header.difficulty
-                        # world=None: the window already persisted the
-                        # nodes
-                        t_save = time.perf_counter()
-                        self.blockchain.save_block(
-                            block, result.receipts, td, world=None
-                        )
-                        # host-side persistence: classification traffic
-                        # for window_report, never a device crossing
-                        LEDGER.record(
-                            "block.save", HOST, 0,
-                            duration=time.perf_counter() - t_save,
-                        )
-                        fault_point("collector.save")
-                        blocks += 1
-                        txs += result.stats.tx_count
-                        gas += result.gas_used
-                        ptxs += result.stats.parallel_count
-                        confl += result.stats.conflict_count
-                    # the commit mark is the job's LAST mutation, and
-                    # it is persistence work: keeping it inside the
-                    # persist span keeps span-recomputed occupancy in
-                    # agreement with the busy-seconds gauge
-                    if intent_seq is not None:
-                        fault_point("collector.commit")
-                        journal.log_commit(intent_seq)
-                    if self.log is not None:
-                        self.log(
-                            f"Committed window [{lo}..{hi}] "
-                            f"({len(results)} blocks) in one batched "
-                            "device pass"
-                        )
-                    # stats land ONLY here, after the commit mark: a
-                    # torn job re-run after a collector death stays
-                    # idempotent — no double counting (nothing below
-                    # can raise before they apply)
-                    stats.blocks += blocks
-                    stats.txs += txs
-                    stats.gas += gas
-                    stats.parallel_txs += ptxs
-                    stats.conflicts += confl
-                    LEDGER.note_blocks(blocks)
-                # the window is durable (best advanced, commit mark
-                # down): the committed store now serves same-or-newer
-                # state, so the read-view overlay can let go of it
-                if self.read_view is not None:
-                    self.read_view.retire_through(hi)
-                t2 = time.perf_counter()
-                ph["collect_bg"] += t1 - t0
-                ph["save_bg"] += t2 - t1
+                with use_tracer(tr):
+                    fault_point("collector.collect")
+                    t0 = time.perf_counter()
+                    with span("window.collect", parent=seal_tok,
+                              block_lo=lo, block_hi=hi), \
+                            LEDGER.context(window=lo, phase="collect"):
+                        # root checks fetch ONLY the per-block root
+                        # digests (32 B x blocks d2h); the window's
+                        # live nodes land in the device mirror d2d
+                        cm.collect_roots(job)  # raises WindowMismatch
+                        cm.admit_mirror(job)
+                    ph["collect_bg"] += time.perf_counter() - t0
 
-            return run
+            def persist_fn():
+                with use_tracer(tr):
+                    fault_point("collector.persist")
+                    t0 = time.perf_counter()
+                    with span("window.persist", parent=seal_tok,
+                              block_lo=lo, block_hi=hi,
+                              live=len(job.live)), \
+                            LEDGER.context(window=lo, phase="persist"):
+                        # the bulk d2h (full mapping) + host spill,
+                        # now OFF the collect critical path
+                        cm.persist(job)
+                    ph["persist_bg"] += time.perf_counter() - t0
+
+            def save_fn():
+                with use_tracer(tr):
+                    t0 = time.perf_counter()
+                    blocks = txs = gas = ptxs = confl = 0
+                    with span("window.save", parent=seal_tok,
+                              block_lo=lo, block_hi=hi,
+                              blocks=len(results)), \
+                            LEDGER.context(window=lo, phase="save"):
+                        for block, result in results:
+                            td = (
+                                self.blockchain.get_total_difficulty(
+                                    block.number - 1
+                                )
+                                or 0
+                            ) + block.header.difficulty
+                            # world=None: the window already persisted
+                            # the nodes
+                            t_save = time.perf_counter()
+                            self.blockchain.save_block(
+                                block, result.receipts, td, world=None
+                            )
+                            # host-side persistence: classification
+                            # traffic for window_report, never a
+                            # device crossing
+                            LEDGER.record(
+                                "block.save", HOST, 0,
+                                duration=time.perf_counter() - t_save,
+                            )
+                            fault_point("collector.save")
+                            blocks += 1
+                            txs += result.stats.tx_count
+                            gas += result.gas_used
+                            ptxs += result.stats.parallel_count
+                            confl += result.stats.conflict_count
+                        # the commit mark is the job's LAST mutation:
+                        # a window is durable only after persist+save
+                        # — the journal's crash-consistency contract
+                        # holds at every stage boundary
+                        if intent_seq is not None:
+                            fault_point("collector.commit")
+                            journal.log_commit(intent_seq)
+                        if self.log is not None:
+                            self.log(
+                                f"Committed window [{lo}..{hi}] "
+                                f"({len(results)} blocks) in one "
+                                "batched device pass"
+                            )
+                        # stats land ONLY here, after the commit mark:
+                        # a torn job re-run after a collector death
+                        # stays idempotent — no double counting
+                        # (nothing below can raise before they apply)
+                        stats.blocks += blocks
+                        stats.txs += txs
+                        stats.gas += gas
+                        stats.parallel_txs += ptxs
+                        stats.conflicts += confl
+                        LEDGER.note_blocks(blocks)
+                    # the window is durable (best advanced, commit
+                    # mark down): the committed store now serves
+                    # same-or-newer state, so the read-view overlay
+                    # can let go of it
+                    if self.read_view is not None:
+                        self.read_view.retire_through(hi)
+                    ph["save_bg"] += time.perf_counter() - t0
+
+            return (collect_fn, persist_fn, save_fn)
 
         def seal_and_submit() -> None:
             nonlocal results_cur, window_parent_root
@@ -620,12 +781,12 @@ class ReplayDriver:
                         [b.header.state_root for b, _ in results_cur],
                     )
             ph["seal"] += time.perf_counter() - t0
-            run_fn = make_collect_job(
+            run_fns = make_stage_jobs(
                 committer, job, results_cur, seal_sp.token, intent_seq
             )
             with span("pipeline.stall", block_lo=lo, block_hi=hi,
                       kind="submit"):
-                ph["collect"] += submit_job(run_fn)
+                ph["collect"] += submit_job(run_fns)
             window_parent_root = results_cur[-1][0].header.state_root
             results_cur = []
 
@@ -729,6 +890,9 @@ class ReplayDriver:
                 )
             raise
         collector.close()
+        # every window is durable: free the last in-flight fused jobs'
+        # device buffers (earlier retirees were freed at later seals)
+        committer.drain_retired()
         stats.seconds = time.perf_counter() - t_start
         # overlap fraction: collector busy seconds NOT spent with the
         # driver blocked on it ((C - stall)/C) — 1.0 means collect+save

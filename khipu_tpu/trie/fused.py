@@ -165,7 +165,10 @@ def _build_fused_impl(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
     of a previous (possibly still in-flight) window's nodes, consumed
     device-to-device so cross-window placeholder refs resolve without
     a host round-trip (the deep-pipeline seam — ledger/window.seal).
-    Output: concatenated digests u8[sum nrows, 32].
+    Output: concatenated digests u8[sum nrows, 32] AND the per-class
+    FINAL substituted encodings (still on device) — the payload the
+    device-resident commit admits into the store's mirror without any
+    node bytes crossing the tunnel (docs/window_pipeline.md).
 
     Substitution child indices address the concatenated [G; ext] digest
     space: this window's rows first (class-major), then the ext rows —
@@ -223,7 +226,11 @@ def _build_fused_impl(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
         encs, digs = jax.lax.fori_loop(
             0, rounds, body, (encs, hash_all(encs))
         )
-        return digs
+        # rounds >= depth, so both digs (= hash of the encodings after
+        # rounds-1 substitution passes) and encs (rounds passes) are at
+        # the fixpoint: encs carry only real child digests and
+        # keccak(encs[c][r]) == digs row r of class c
+        return digs, encs
 
     return run
 
@@ -246,15 +253,68 @@ class FusedJob:
     ``digests`` stays referenced after collect so a LATER window's
     dispatch can gather rows from it device-to-device (resolved-input
     tiles — the deep-pipeline cross-window mechanism); ``dpos`` maps
-    each placeholder to its row for that gather."""
+    each placeholder to its row for that gather. Once the window
+    retires past the pipeline (its rows can no longer be gathered),
+    ``release()`` drops the device buffers so HBM stays O(in-flight
+    windows), not O(replayed chain).
 
-    __slots__ = ("digests", "class_rows", "dpos", "_mapping")
+    ``encs`` are the per-class FINAL substituted encodings, still on
+    device, in the same class/row order as ``class_rows`` — the
+    device-resident commit gathers live rows out of them straight into
+    the store mirror (storage/device_mirror.py) with zero node bytes
+    crossing the tunnel."""
 
-    def __init__(self, digests, class_rows, dpos=None):
+    __slots__ = ("digests", "encs", "class_rows", "dpos", "_mapping")
+
+    def __init__(self, digests, class_rows, dpos=None, encs=None):
         self.digests = digests  # device u8[sum rows, 32]
+        self.encs = encs  # per-class device u8[nrows, nb*RATE] or None
         self.class_rows = class_rows  # [(phs in row order, global base)]
         self.dpos = dpos or {}  # ph -> global row (cross-window gather)
         self._mapping: Dict[bytes, bytes] = None
+
+    def fetch_rows(self, refs) -> Dict[bytes, bytes]:
+        """Digests of ``refs`` ONLY: a device-to-device row gather plus
+        a 32 B x n host copy — the collect-stage root check's entire
+        d2h traffic, vs. ``collect``'s full-tile haul (which the staged
+        pipeline defers to the async persist stage)."""
+        if self._mapping is not None:
+            m = self._mapping
+            return {r: m[r] for r in refs if r in m}
+        out: Dict[bytes, bytes] = {}
+        if self.digests is None:
+            return out
+        present = [r for r in refs if r in self.dpos]
+        if not present:
+            return out
+        import jax
+
+        rows = np.asarray(
+            [self.dpos[r] for r in present], dtype=np.int32
+        )
+        sub = self.digests[rows]  # d2d gather — no tile crosses
+        with _span("fused.rootcheck", rows=len(present)):
+            with LEDGER.transfer("fused.rootcheck", D2H, sub.size):
+                d = np.asarray(jax.device_get(sub))
+        for i, r in enumerate(present):
+            out[r] = d[i].tobytes()
+        return out
+
+    def release_encs(self) -> None:
+        """Drop the final-encoding buffers (after the mirror admit has
+        gathered what it needs — the gathered tiles are independent
+        arrays)."""
+        self.encs = None
+
+    def release(self) -> None:
+        """Drop ALL device references (digest tile + encodings). Called
+        when the window retires from the pipeline: its rows left
+        ``_inflight_rows`` so no later seal can gather from it, and
+        ``_mapping`` (host bytes) is what any late reader needs.
+        Without this the digest tiles of every replayed window stayed
+        referenced and HBM grew O(replayed chain)."""
+        self.encs = None
+        self.digests = None
 
     def collect(self) -> Dict[bytes, bytes]:
         if self._mapping is not None:
@@ -468,7 +528,11 @@ def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext) -> FusedJob:
     else:
         ext_buf = ext_dev
 
-    rounds = _pow2(depth, floor=8)  # coarse: depth 5 and 8 share a compile
+    # coarse: depth 3 and 4 share a compile. Floor 4 (was 8): shallow
+    # windows — the common replay shape — were paying 2x the fixpoint
+    # compute for bucketing alone, and the collector stage that blocks
+    # on this program is the pipeline's critical stage
+    rounds = _pow2(depth, floor=4)
     run = _build_fused(tuple(sig), rounds, use_jnp, ext_rows)
 
     # host->device upload = every host-built input buffer (the ext tile
@@ -481,7 +545,7 @@ def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext) -> FusedJob:
         up += ext_buf.nbytes
     with LEDGER.transfer("fused.dispatch", H2D, up):
         # async: no host sync
-        digests = run(*[*enc_bufs, *sub_arrays, ext_buf])
+        digests, final_encs = run(*[*enc_bufs, *sub_arrays, ext_buf])
     try:
         # start the device->host copy NOW: it streams as soon as the
         # fixpoint finishes, so collect()'s device_get returns without
@@ -494,4 +558,4 @@ def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext) -> FusedJob:
     for nb in class_list:
         class_rows.append((classes[nb], base))
         base += nrows_pad[nb]
-    return FusedJob(digests, class_rows, dpos)
+    return FusedJob(digests, class_rows, dpos, encs=list(final_encs))

@@ -8,16 +8,19 @@
 # Usage:
 #   scripts/bench_gate.sh [BASELINE.json] [extra bench.py args...]
 #
-# Defaults: BENCH_r05.json (the newest captured baseline) and the
-# default thresholds baked into bench.py (blocks/s may drop to 0.5x,
-# collect share may grow +0.15 absolute, device bytes/block may grow
-# 1.25x — see DEFAULT_COMPARE_THRESHOLDS). Override per-run, e.g.:
-#   scripts/bench_gate.sh BENCH_r05.json --min-blocks-ratio=0.8
+# Defaults: BENCH_r06.json (the newest captured baseline — the first
+# one carrying movement numbers, so the bytes/block ratio gate is
+# live) and the thresholds baked into bench.py, EXCEPT the bytes
+# ratio: r06 was captured by the same staged-collector code the gate
+# runs, so device bytes/block should be reproducible within noise —
+# we pin it at 1.05x instead of the legacy 1.25x. Override per-run:
+#   scripts/bench_gate.sh BENCH_r06.json --min-blocks-ratio=0.8
+# (a later arg wins: bench.py takes the last value of a repeated flag)
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
-BASELINE="${1:-BENCH_r05.json}"
+BASELINE="${1:-BENCH_r06.json}"
 shift || true
 
 if [ ! -f "$BASELINE" ]; then
@@ -35,6 +38,6 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
 
 echo "== bench regression gate (baseline: $BASELINE) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-}" python bench.py \
-    --compare="$BASELINE" "$@"
+    --compare="$BASELINE" --max-bytes-ratio=1.05 "$@"
 
 echo "bench_gate: OK"

@@ -56,12 +56,44 @@ class TestRuleFixtures:
         findings = _scan(tmp_path, {"mod.py": (
             "import jax\n"
             "def timed(x):\n"
-            "    with LEDGER.transfer('s', 'd2h', 4):\n"
+            "    with LEDGER.transfer('ops.keccak', 'd2h', 4):\n"
             "        return jax.device_get(x)\n"
             "def oneshot(x):\n"
             "    out = jax.device_get(x)\n"
-            "    LEDGER.record('s', 'd2h', 4)\n"
+            "    LEDGER.record('ops.keccak', 'd2h', 4)\n"
             "    return out\n"
+        )})
+        assert findings == []
+
+    def test_kl001_misspelled_site_fires(self, tmp_path):
+        """A metered crossing with a site string outside
+        profiler.KNOWN_SITES still trips KL001: the bytes land in the
+        totals but fork their own series and vanish from the window
+        report's class breakdown."""
+        findings = _scan(tmp_path, {"mod.py": (
+            "import jax\n"
+            "def timed(x):\n"
+            "    with LEDGER.transfer('fused.colect', 'd2h', 4):\n"
+            "        return jax.device_get(x)\n"
+            "def oneshot(x):\n"
+            "    out = jax.device_get(x)\n"
+            "    LEDGER.record('mirror.admitt', 'h2d', 4)\n"
+            "    return out\n"
+        )})
+        assert _rules_of(findings) == ["KL001"]
+        msgs = sorted(f.message for f in findings)
+        assert any("fused.colect" in m for m in msgs)
+        assert any("mirror.admitt" in m for m in msgs)
+        assert all("KNOWN_SITES" in m for m in msgs)
+
+    def test_kl001_dynamic_site_is_out_of_scope(self, tmp_path):
+        """A non-literal site expression can't be validated lexically —
+        the rule stays quiet rather than guessing."""
+        findings = _scan(tmp_path, {"mod.py": (
+            "import jax\n"
+            "def timed(x, site):\n"
+            "    with LEDGER.transfer(site, 'd2h', 4):\n"
+            "        return jax.device_get(x)\n"
         )})
         assert findings == []
 

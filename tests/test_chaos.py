@@ -316,6 +316,63 @@ class TestCrashRecovery:
         ReplayDriver(bc, resume_cfg).replay(chain[6:])
         _assert_same_chain(bc, _clean_reference(chain))
 
+    def test_kill_mid_spill_recover_resume_bit_exact(self, chain):
+        """Death INSIDE the async spill (collector.spill fires between
+        the account-store and storage-store writes of the persist
+        stage): the window's nodes are half-spilled and no block of it
+        saved. Recovery must roll the torn window back bit-exact —
+        content-addressed orphans from the half spill are harmless."""
+        cfg = _cfg(window=2, depth=2, degrade=False)
+        bc = _fresh(cfg)
+        plan = FaultPlan(
+            seed=7, rules=[FaultRule("collector.spill", "die", after=2,
+                                     times=1)]
+        )
+        with active(plan):
+            with pytest.raises(CollectorDied):
+                ReplayDriver(bc, cfg).replay(chain)
+        assert [s for (s, _, _, _) in plan.fired] == ["collector.spill"]
+
+        driver = ReplayDriver(bc, cfg)
+        report = driver.recover()
+        assert report.scanned >= 1
+        assert report.rolled_back >= 1
+        assert bc.storages.window_journal.pending() == []
+
+        resume_cfg = _cfg(window=1, depth=1)
+        ReplayDriver(bc, resume_cfg).replay(
+            chain[bc.best_block_number:]
+        )
+        _assert_same_chain(bc, _clean_reference(chain))
+
+    def test_kill_between_persist_and_save_rolls_back(self, chain):
+        """Death ON the persist->save stage boundary: the window's
+        nodes are fully spilled but no block record exists and the
+        commit mark is missing. The journal contract holds — the
+        window is NOT durable until persist AND save completed, so
+        recovery rolls it back (node orphans are content-addressed
+        noise) and the resume lands bit-exact."""
+        cfg = _cfg(window=2, depth=2, degrade=False)
+        bc = _fresh(cfg)
+        # 'after=2, times=1': the 3rd window entering its save stage
+        # dies before its first save_block
+        plan = FaultPlan(
+            seed=11, rules=[FaultRule("collector.save", "die", after=4,
+                                      times=1)]
+        )
+        with active(plan):
+            with pytest.raises(CollectorDied):
+                ReplayDriver(bc, cfg).replay(chain)
+
+        report = ReplayDriver(bc, cfg).recover()
+        assert report.rolled_back >= 1
+        assert bc.storages.window_journal.pending() == []
+        resume_cfg = _cfg(window=1, depth=1)
+        ReplayDriver(bc, resume_cfg).replay(
+            chain[bc.best_block_number:]
+        )
+        _assert_same_chain(bc, _clean_reference(chain))
+
     def test_service_board_runs_recovery_on_boot(self, chain):
         """ServiceBoard's __init__ settles pending intents before any
         service starts (the operator-facing restart path)."""
@@ -366,6 +423,24 @@ class TestDegrade:
         assert stats.blocks == N_BLOCKS
         assert PIPELINE_GAUGES["collector_deaths"] == deaths0 + 1
         assert PIPELINE_GAUGES["sync_fallback_windows"] > sync0
+        _assert_same_chain(bc, _clean_reference(chain))
+
+    def test_persist_stage_death_degrades_to_sync_commits(self, chain):
+        """A death on the collect->persist stage boundary (the job
+        already rootchecked, its spill never started) degrades the
+        driver to synchronous commits; the torn job's remaining stages
+        re-run inline and the chain lands bit-exact."""
+        cfg = _cfg(window=2, depth=2, degrade=True)
+        bc = _fresh(cfg)
+        deaths0 = PIPELINE_GAUGES["collector_deaths"]
+        plan = FaultPlan(
+            seed=4, rules=[FaultRule("collector.persist", "die",
+                                     after=1, times=1)]
+        )
+        with active(plan):
+            stats = ReplayDriver(bc, cfg).replay(chain)
+        assert stats.blocks == N_BLOCKS
+        assert PIPELINE_GAUGES["collector_deaths"] == deaths0 + 1
         _assert_same_chain(bc, _clean_reference(chain))
 
     def test_fused_dispatch_failure_falls_back_to_host(self, chain):
@@ -605,6 +680,49 @@ def _nodes(n, tag=0):
         v = b"node-" + tag.to_bytes(2, "big") + i.to_bytes(4, "big") * 5
         out[keccak256(v)] = v
     return out
+
+
+class TestStagedPipelineSweep:
+    def test_stage_boundary_die_sweep_120_seeds(self, chain):
+        """The async-spill analog of the 120-seed corruption sweep:
+        seeded deaths across every stage boundary of the staged
+        collector (rootcheck/admit -> spill -> save -> commit mark,
+        plus the mid-spill seam). Whatever the seed kills, journal
+        recovery plus a serial resume must land on the bit-exact
+        chain — a torn window is NEVER silently half-durable."""
+        sites = ("collector.collect", "collector.persist",
+                 "collector.spill", "collector.save",
+                 "collector.commit")
+        ref = _clean_reference(chain)
+        killed = survived = 0
+        for seed in range(120):
+            site = sites[seed % len(sites)]
+            cfg = _cfg(window=2, depth=2, degrade=False)
+            bc = _fresh(cfg)
+            # deterministic depth: die on the k-th visit to the site;
+            # k beyond the run's visit count = a clean, uninterrupted
+            # replay (both outcomes exercised across the sweep)
+            plan = FaultPlan(
+                seed=seed,
+                rules=[FaultRule(site, "die", times=1,
+                                 after=(seed // len(sites)) % 14)],
+            )
+            with active(plan):
+                try:
+                    ReplayDriver(bc, cfg).replay(chain)
+                    survived += 1
+                except CollectorDied:
+                    killed += 1
+                    ReplayDriver(bc, cfg).recover()
+                    assert bc.storages.window_journal.pending() == []
+            if bc.best_block_number < N_BLOCKS:
+                resume_cfg = _cfg(window=1, depth=1)
+                ReplayDriver(bc, resume_cfg).replay(
+                    chain[bc.best_block_number:]
+                )
+            _assert_same_chain(bc, ref)
+        # the harness genuinely exercised both outcomes
+        assert killed > 20 and survived > 20, (killed, survived)
 
 
 class TestClusterChaos:

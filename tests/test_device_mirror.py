@@ -91,6 +91,91 @@ def test_duplicate_admit_bookkeeping():
     assert m.verify() == 0
 
 
+def _device_tile(encs):
+    """One padded TILE of on-device encodings + claims: the first
+    len(encs) rows are real, the rest repeat row 0 (claim-consistent
+    padding, the unit-test analog of the fused dummy row)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from khipu_tpu.storage.device_mirror import RATE, TILE
+
+    width = RATE
+    padded = np.zeros((TILE, width), np.uint8)
+    claims = np.zeros((TILE, 32), np.uint8)
+    for r in range(TILE):
+        enc = encs[r] if r < len(encs) else encs[0]
+        padded[r, : len(enc)] = np.frombuffer(enc, np.uint8)
+        padded[r, len(enc)] ^= 0x01
+        padded[r, width - 1] ^= 0x80
+        claims[r] = np.frombuffer(keccak256(enc), np.uint8)
+    return jnp.asarray(padded), jnp.asarray(claims)
+
+
+def test_alias_rows_hidden_until_rekey():
+    """Device-admitted window rows live in the placeholder (alias)
+    namespace: invisible to content-address reads until the persist
+    stage's rekey publishes them under their real hashes — a reader
+    following a published root must never see un-published rows."""
+    from khipu_tpu.storage.device_mirror import TILE
+
+    m = DeviceNodeMirror(capacity_rows_per_class=1024)
+    encs = [bytes([i + 1]) * (40 + 7 * i) for i in range(3)]
+    enc_dev, claim_dev = _device_tile(encs)
+    aliases = [b"\xaa" + i.to_bytes(31, "big") for i in range(3)]
+    keys = aliases + [None] * (TILE - 3)
+    lengths = [len(e) for e in encs] + [0] * (TILE - 3)
+    m.admit_device(1, keys, enc_dev, claim_dev, lengths)
+    for enc in encs:
+        assert m.get(keccak256(enc)) is None, "unpublished row served"
+    assert m.verify() == 0  # claim-consistent even while aliased
+    mapping = {a: keccak256(e) for a, e in zip(aliases, encs)}
+    mapping[b"\xbb" * 32] = b"\xcc" * 32  # unrelated entries are inert
+    assert m.rekey(mapping) == 3
+    for enc in encs:
+        assert m.get(keccak256(enc)) == enc
+    assert m.verify() == 0
+
+
+def test_drop_aliases_forgets_unpublished_rows():
+    """A torn window's aliases are dropped, never promoted: a later
+    rekey with the same placeholder bytes must move nothing."""
+    from khipu_tpu.storage.device_mirror import TILE
+
+    m = DeviceNodeMirror(capacity_rows_per_class=1024)
+    encs = [b"\x5a" * 44]
+    enc_dev, claim_dev = _device_tile(encs)
+    aliases = [b"\xaa" * 32]
+    m.admit_device(
+        1, aliases + [None] * (TILE - 1), enc_dev, claim_dev,
+        [44] + [0] * (TILE - 1),
+    )
+    m.drop_aliases(aliases)
+    assert m.rekey({aliases[0]: keccak256(encs[0])}) == 0
+    assert m.get(keccak256(encs[0])) is None
+
+
+def test_node_storage_read_through_and_detach():
+    """NodeStorage falls through to the mirror for not-yet-spilled
+    nodes; recovery's detach makes the same read miss (the mirror is
+    volatile — crash verification must see host-durable state only)."""
+    from khipu_tpu.storage.storages import Storages
+
+    storages = Storages()
+    m = DeviceNodeMirror(capacity_rows_per_class=1024)
+    enc = b"\x42" * 80
+    h = keccak256(enc)
+    m.admit({h: enc})
+    m.flush()
+    storages.attach_mirror(m)
+    assert storages.account_node_storage.get(h) == enc
+    assert storages.storage_node_storage.get(h) == enc
+    assert storages.get_node_any(h) == enc
+    storages.detach_mirror()
+    assert storages.account_node_storage.get(h) is None
+    assert storages.get_node_any(h) is None
+
+
 def test_long_string_overflow_rejected():
     """Adversarial RLP length fields near PY_SSIZE_T_MAX must raise
     RLPError (not wrap around) in BOTH codecs."""

@@ -703,6 +703,14 @@ class TestBenchTraceRegistrySmoke:
         # phase histograms observed real latencies during the run
         assert report["phase_observations"]
         assert sum(report["phase_observations"].values()) > 0
+        # the device-resident-commit pin: collect-phase d2h stays at
+        # (at most) the 32 B/block rootcheck — the staged pipeline must
+        # never pull node bytes back to host on the critical path. The
+        # host-hasher smoke run moves ZERO device bytes in collect; the
+        # device path is pinned <=256 B/block by TestDeviceMirrorCommit.
+        assert report["movement"]["collect_d2h_bytes_per_block"] <= 64, (
+            report["movement"]
+        )
 
         snap = REGISTRY.snapshot()
         text = REGISTRY.prometheus_text()
