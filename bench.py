@@ -1116,13 +1116,26 @@ def _serve_setup(n_blocks, txs_per_block, window=2, depth=2):
     so the production limits would never saturate in-harness)."""
     import dataclasses
 
-    from khipu_tpu.config import ServingConfig, SyncConfig, fixture_config
+    from khipu_tpu.config import (
+        ServingConfig,
+        SyncConfig,
+        TelemetryConfig,
+        fixture_config,
+    )
     from khipu_tpu.domain.block import Block as _Block
     from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
     from khipu_tpu.domain.transaction import Transaction, sign_transaction
     from khipu_tpu.jsonrpc import EthService, JsonRpcServer
+    from khipu_tpu.observability.registry import MetricsRegistry
+    from khipu_tpu.observability.telemetry import (
+        ClusterTelemetry,
+        Watchdog,
+        decode_metrics,
+        encode_metrics,
+    )
     from khipu_tpu.serving import AdmissionController, ReadView, ServingPlane
     from khipu_tpu.serving.admission import (
+        cluster_pressure,
         journal_pressure,
         pipeline_pressure,
         txpool_pressure,
@@ -1182,6 +1195,44 @@ def _serve_setup(n_blocks, txs_per_block, window=2, depth=2):
     # 0.85 write threshold and the 4x step is what crosses it
     pool = PendingTransactionsPool(capacity=192)
     read_view = ReadView(target)
+
+    # cluster telemetry over two in-process fake shards: each "shard"
+    # is its own MetricsRegistry scraped through the telemetry codec —
+    # the bench exercises merge + health + the cluster admission signal
+    # without paying for real gRPC servers
+    tel_cfg = TelemetryConfig(
+        enabled=True, scrape_interval=0.5, staleness_s=5.0
+    )
+    shard_regs = {}
+    for i, ep in enumerate(("bench-shard-a:0", "bench-shard-b:0")):
+        reg = MetricsRegistry()
+        reg.gauge("khipu_pipeline_in_flight").set(i)
+        reg.counter("khipu_shard_requests_total").inc(10 + i)
+        reg.histogram(
+            "khipu_rpc_latency_seconds", buckets=(0.001, 0.01, 0.1)
+        ).observe(0.005)
+        shard_regs[ep] = reg
+
+    class _Scrape:
+        def __init__(self, reg):
+            self.reg = reg
+
+        def get_metrics(self):
+            return decode_metrics(encode_metrics(self.reg))
+
+        def close(self):
+            pass
+
+    telemetry = ClusterTelemetry(
+        list(shard_regs), config=tel_cfg,
+        client_factory=lambda ep: _Scrape(shard_regs[ep]),
+    )
+    watchdog = Watchdog(
+        config=tel_cfg,
+        journal_depth=lambda: target.storages.window_journal.depth,
+        telemetry=telemetry,
+    )
+
     admission = AdmissionController(
         serve_cfg,
         limits={"cheap": 4, "read": 4, "execute": 2, "write": 2},
@@ -1189,15 +1240,18 @@ def _serve_setup(n_blocks, txs_per_block, window=2, depth=2):
             pipeline_pressure(),
             journal_pressure(target.storages, depth),
             txpool_pressure(pool),
+            cluster_pressure(telemetry),
         ],
     )
     plane = ServingPlane(serve_cfg, read_view=read_view,
                          admission=admission)
     service = EthService(
-        target, cfg, pool, read_view=read_view, serving=plane
+        target, cfg, pool, read_view=read_view, serving=plane,
+        telemetry=telemetry,
     )
     server = JsonRpcServer(service, serving=plane)
-    return cfg, target, wire, addrs, receivers, plane, service, server
+    return (cfg, target, wire, addrs, receivers, plane, service,
+            server, telemetry, watchdog)
 
 
 def bench_serve(smoke=False):
@@ -1221,7 +1275,7 @@ def bench_serve(smoke=False):
 
     n_blocks = 6 if smoke else 48
     (cfg, target, wire, addrs, receivers, plane, service,
-     server) = _serve_setup(n_blocks, txs_per_block=6)
+     server, telemetry, watchdog) = _serve_setup(n_blocks, txs_per_block=6)
     transport = InProcessTransport(server)
     nonce_addrs = ["0x" + a.hex() for a in addrs]
     # balances are checked on ACCUMULATE-ONLY addresses (receivers +
@@ -1310,6 +1364,21 @@ def bench_serve(smoke=False):
         LEDGER.record("bench.smoke", H2D, 1)
         if not was_on:
             LEDGER.disable()
+        # cluster telemetry: scrape the fake shards, then pin the new
+        # families in the DRIVER exposition and the one-TYPE-per-family
+        # invariant in the MERGED exposition. A deliberate
+        # journal-runaway trip (depth bound 0 vs the real journal is
+        # wrong on purpose — the trip must fire deterministically)
+        # populates khipu_watchdog_trips_total before the pin.
+        telemetry.scrape_once()
+        import dataclasses as _dc
+
+        trip_dog = type(watchdog)(
+            config=_dc.replace(watchdog.config, journal_runaway_depth=0),
+            pipeline={}, journal_depth=lambda: 1, telemetry=telemetry,
+        )
+        tripped = trip_dog.check_once()
+        assert "journal_runaway" in tripped, tripped
         text = service.khipu_metrics_text()
         lat = text.count("# TYPE khipu_rpc_latency_seconds histogram")
         shed = text.count("# TYPE khipu_rpc_shed_total counter")
@@ -1319,10 +1388,25 @@ def bench_serve(smoke=False):
         ts = text.count(
             "# TYPE khipu_device_transfer_seconds_total counter"
         )
+        sh = text.count("# TYPE khipu_shard_health gauge")
+        wd = text.count("# TYPE khipu_watchdog_trips_total counter")
         assert lat == 1, f"latency histogram TYPE lines: {lat}"
         assert shed == 1, f"shed counter TYPE lines: {shed}"
         assert tb == 1, f"transfer bytes TYPE lines: {tb}"
         assert ts == 1, f"transfer seconds TYPE lines: {ts}"
+        assert sh == 1, f"shard health TYPE lines: {sh}"
+        assert wd == 1, f"watchdog trips TYPE lines: {wd}"
+        assert 'khipu_watchdog_trips_total{kind="journal_runaway"} 1' \
+            in text, text
+        ctext = service.khipu_cluster_metrics_text()
+        ctypes = [
+            line.split()[2] for line in ctext.splitlines()
+            if line.startswith("# TYPE")
+        ]
+        assert len(ctypes) == len(set(ctypes)), (
+            f"duplicate families in merged exposition: {ctypes}"
+        )
+        assert 'shard="bench-shard-a:0"' in ctext, ctext
         assert violations == 0, (
             mixed.violations + overload.violations
         )
@@ -1332,6 +1416,8 @@ def bench_serve(smoke=False):
             violations=violations,
             exposition_families_ok=True,
             transfer_families_ok=True,
+            cluster_families_ok=True,
+            watchdog_trip_ok=True,
             slo_methods=len(plane.slo.evaluate()["methods"]),
         )
         return
@@ -1351,6 +1437,13 @@ def bench_serve(smoke=False):
         f"{p99_admitted * 1e3:.3f}ms vs floor {p99_floor * 1e3:.3f}ms"
     )
     budget = plane.slo.evaluate()["errorBudget"]
+    # shed attribution: which pressure signal (pipeline / journal /
+    # txpool / cluster) got the blame for each pressure shed, plus the
+    # live per-signal readout — the cluster signal reports even when
+    # healthy (0.0), proving the plane is wired in
+    telemetry.scrape_once()
+    snap = plane.admission.snapshot()
+    assert "cluster" in snap["pressureBySignal"], snap
     emit(
         "rpc_mid_sync_qps",
         round(mixed.qps, 1),
@@ -1380,6 +1473,8 @@ def bench_serve(smoke=False):
             p99_admitted / p99_unloaded if p99_unloaded else 0, 2
         ),
         error_budget_consumed=budget["budgetConsumed"],
+        shed_by_signal=snap["shedBySignal"],
+        pressure_by_signal=snap["pressureBySignal"],
         note="admitted p99 must stay bounded while excess load sheds "
              "with -32005 (SEDA-style staged admission)",
     )

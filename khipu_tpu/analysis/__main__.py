@@ -16,7 +16,11 @@ from khipu_tpu.analysis.core import (
     run_analysis,
     write_baseline,
 )
-from khipu_tpu.analysis.report import render_json, render_text
+from khipu_tpu.analysis.report import (
+    render_annotations,
+    render_json,
+    render_text,
+)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -53,6 +57,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--rules", default="",
         help="comma-separated rule ids to run (default: all)",
     )
+    ap.add_argument(
+        "--annotate", metavar="JSON_PATH", default=None,
+        help="review-tooling mode: write the SARIF-ish JSON document "
+             "to JSON_PATH and print findings as 'file:line: [KL00x] "
+             "msg' annotation lines (exit codes unchanged)",
+    )
     args = ap.parse_args(argv)
 
     rules = None
@@ -83,7 +93,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 0
 
-    if args.format == "json":
+    if args.annotate:
+        with open(args.annotate, "w") as fh:
+            fh.write(render_json(new, known, stale))
+        ann = render_annotations(new)
+        if ann:
+            print(ann)
+        print(
+            f"khipu-lint: {len(new)} new finding(s), JSON artifact at "
+            f"{args.annotate}"
+        )
+    elif args.format == "json":
         print(render_json(new, known, stale))
     else:
         print(render_text(new, known, stale))

@@ -38,6 +38,15 @@ def render_text(new: Sequence[Finding], baselined: Sequence[Finding],
     return "\n".join(lines)
 
 
+def render_annotations(new: Sequence[Finding]) -> str:
+    """One line per new finding in the ``file:line: [RULE] message``
+    shape review tooling greps (the same grammar compiler errors use,
+    so editors and CI annotators parse it for free)."""
+    return "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in new
+    )
+
+
 def render_json(new: Sequence[Finding], baselined: Sequence[Finding],
                 stale: Sequence[dict]) -> str:
     from khipu_tpu.analysis.rules import ALL_RULES

@@ -30,6 +30,12 @@ Methods (all request/response = opaque bytes):
                  [sid, parent|"" , name, t0_wall_us, t1_wall_us, tid,
                   thread_name, error|"", tags_json] with ABSOLUTE
                  shard-wall microsecond stamps
+  GetMetrics:    b"" -> rlp([[name, kind, help, labels_json,
+                 value_json], ...]) — one consistent pull of the
+                 shard's MetricsRegistry families (instruments + pull
+                 collectors), the scrape half of the cluster telemetry
+                 plane (observability/telemetry.py): ClusterTelemetry
+                 merges these into the shard-labeled exposition
 
 Trace propagation (Dapper-style): every BridgeClient call carries
 ``khipu-trace-id`` / ``khipu-parent-token`` / ``khipu-sampled`` gRPC
@@ -131,7 +137,7 @@ def decode_trace_spans(payload: bytes) -> dict:
 class BridgeServer:
     def __init__(self, blockchain: Blockchain, config: KhipuConfig,
                  device_commit: bool = False, max_workers: int = 4,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None, registry=None):
         self.blockchain = blockchain
         self.config = config
         self.device_commit = device_commit
@@ -144,6 +150,13 @@ class BridgeServer:
         # operator poking ``server.tracer.enable()``.
         self.tracer = tracer if tracer is not None else Tracer()
         apply_trace_config(config.observability, self.tracer)
+        # the registry GetMetrics serves: the process REGISTRY by
+        # default; in-process multi-shard tests hand each server its
+        # own MetricsRegistry so the scraped families stay per-shard
+        if registry is None:
+            from khipu_tpu.observability.registry import REGISTRY
+            registry = REGISTRY
+        self.registry = registry
 
     # ------------------------------------------------------------ methods
 
@@ -242,6 +255,11 @@ class BridgeServer:
     def _get_trace_spans(self, request: bytes, context) -> bytes:
         return _encode_trace_spans(self.tracer)
 
+    def _get_metrics(self, request: bytes, context) -> bytes:
+        from khipu_tpu.observability.telemetry import encode_metrics
+
+        return encode_metrics(self.registry)
+
     # ------------------------------------------------------------- server
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -294,6 +312,7 @@ class BridgeServer:
             "GetTraceSpans": _guarded(
                 "GetTraceSpans", self._get_trace_spans
             ),
+            "GetMetrics": _guarded("GetMetrics", self._get_metrics),
         }
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=self.max_workers)
@@ -429,6 +448,14 @@ class BridgeClient:
         """Pull the shard's span ring: {traceId, spans:[{...}]} with
         absolute shard-wall second stamps (see decode_trace_spans)."""
         return decode_trace_spans(self._call("GetTraceSpans", b""))
+
+    def get_metrics(self):
+        """Pull one consistent snapshot of the shard's metric families:
+        ``{name: (kind, help, [(labels_dict, value)])}`` — the same
+        shape ``MetricsRegistry.families()`` returns locally."""
+        from khipu_tpu.observability.telemetry import decode_metrics
+
+        return decode_metrics(self._call("GetMetrics", b""))
 
     def close(self) -> None:
         self.channel.close()

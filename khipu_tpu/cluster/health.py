@@ -46,6 +46,30 @@ class HealthMonitor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         client._health = self
+        # liveness verdicts were a private dict invisible to metrics;
+        # export them as khipu_shard_up{endpoint=} (REPLACES by key —
+        # the newest monitor owns the samples, same story as the
+        # cluster client's collector)
+        try:
+            from khipu_tpu.observability.registry import REGISTRY
+
+            REGISTRY.register_collector(
+                "cluster_health", self._registry_samples
+            )
+        except Exception:
+            pass  # metrics are optional; the probe loop is not
+
+    def _registry_samples(self) -> list:
+        samples = [
+            ("khipu_shard_up", "gauge", {"endpoint": ep},
+             1 if alive else 0)
+            for ep, alive in sorted(self._alive.items())
+        ]
+        samples.append((
+            "khipu_shard_transitions_total", "counter", {},
+            self.transitions,
+        ))
+        return samples
 
     # ------------------------------------------------------------ probes
 

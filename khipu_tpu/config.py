@@ -241,6 +241,47 @@ class ServingConfig:
 
 
 @dataclass(frozen=True)
+class TelemetryConfig:
+    """Cluster telemetry plane (observability/telemetry.py —
+    docs/observability.md "cluster telemetry").
+
+    ``enabled=False`` (the default) is the zero-cost contract:
+    ``ServiceBoard.start_telemetry()`` returns ``None``, no poller or
+    watchdog thread starts, no ``GetMetrics`` RPC is ever issued, and
+    replay behavior is bit-exact identical. Enabled, a ``ClusterTelemetry``
+    poller scrapes every shard registry over the bridge on a
+    seeded-jitter interval (KL003: the jitter stream comes from
+    ``jitter_seed``, never wall-clock entropy) and a ``Watchdog`` daemon
+    watches the collector pipeline gauges on ``time.monotonic()``."""
+
+    enabled: bool = False
+    # shard scrape cadence (s); actual sleep is interval * (0.8..1.2)
+    # drawn from a seeded RNG so concurrent pollers de-phase
+    scrape_interval: float = 5.0
+    jitter_seed: int = 0
+    # a shard whose last successful scrape is older than this stops
+    # contributing samples to the merged exposition (age-out) and its
+    # freshness health component decays to zero
+    staleness_s: float = 15.0
+    # khipu_shard_health below this marks the shard degraded in
+    # khipu_cluster_report (and is the score the 2-shard kill test pins)
+    health_threshold: float = 0.5
+    # pipeline stall watchdog (one daemon thread, monotonic clock)
+    watchdog: bool = True
+    watchdog_interval: float = 1.0
+    # stage depth > 0 with busy_s flat for this long => stage_stall trip
+    stall_after_s: float = 5.0
+    # journal pending() beyond this depth => journal_runaway trip
+    journal_runaway_depth: int = 8
+    # gauge families echoed into khipu_cluster_report per shard
+    key_gauges: tuple = (
+        "khipu_pipeline_in_flight",
+        "khipu_journal_depth",
+        "khipu_stage_persist_depth",
+    )
+
+
+@dataclass(frozen=True)
 class FaultConfig:
     """Deterministic fault injection (chaos/ package — docs/recovery.md).
 
@@ -266,6 +307,7 @@ class KhipuConfig:
     )
     faults: FaultConfig = field(default_factory=FaultConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
 
 def fixture_config(

@@ -60,6 +60,7 @@ class EthService:
         tracer=None,
         read_view=None,
         serving=None,
+        telemetry=None,
     ):
         self.blockchain = blockchain
         self.config = config
@@ -82,6 +83,10 @@ class EthService:
         # sharded node-cache cluster client (cluster/client.py); when
         # set, khipu_metrics surfaces its per-shard counters
         self.cluster = cluster
+        # cluster telemetry plane (observability/telemetry.py); when
+        # set, khipu_cluster_metrics_text / khipu_cluster_report serve
+        # the merged shard view
+        self.telemetry = telemetry
         # the flight recorder the khipu_traces / khipu_dump_chrome_trace
         # RPCs serve from (a board-owned instance when embedded in a
         # ServiceBoard; the process default otherwise)
@@ -626,6 +631,24 @@ class EthService:
         from khipu_tpu.observability.registry import REGISTRY
 
         return REGISTRY.prometheus_text()
+
+    def khipu_cluster_metrics_text(self) -> str:
+        """Merged cluster exposition (observability/telemetry.py):
+        every scraped shard's families in one Prometheus document —
+        counters/gauges ``shard``-labeled, aligned histograms summed,
+        stale shards aged out. Requires an attached ClusterTelemetry
+        (``ServiceBoard.start_telemetry``)."""
+        if self.telemetry is None:
+            raise RpcError(-32000, "cluster telemetry not enabled")
+        return self.telemetry.cluster_text()
+
+    def khipu_cluster_report(self) -> dict:
+        """Cluster health report: per-shard up/down, scrape staleness,
+        health-score breakdown, key gauges, and the admission-facing
+        pressure value."""
+        if self.telemetry is None:
+            raise RpcError(-32000, "cluster telemetry not enabled")
+        return self.telemetry.report()
 
     def khipu_traces(self) -> dict:
         """Flight-recorder summary (observability/export.snapshot):

@@ -46,6 +46,7 @@ __all__ = [
     "GaugeGroup",
     "MetricsRegistry",
     "REGISTRY",
+    "render_exposition",
 ]
 
 # latency-shaped default buckets (seconds), Prometheus convention:
@@ -216,7 +217,45 @@ def _label_key(labels: Dict[str, str]) -> str:
 
 
 def _escape(v: str) -> str:
+    """Label-VALUE escaping (exposition format 0.0.4): backslash first,
+    then double-quote and newline — the order that round-trips."""
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-line escaping: only backslash and newline (the format does
+    NOT escape quotes in help text — they are legal verbatim)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def render_exposition(families) -> str:
+    """Render ``{name: (kind, help, [(labels_dict, value)])}`` as
+    Prometheus text exposition 0.0.4. Shared by ``prometheus_text()``
+    and the cluster-merged exposition (observability/telemetry.py) so
+    both uphold the same invariant: each family appears EXACTLY once
+    (one ``# TYPE`` line, then every labeled sample)."""
+    lines: List[str] = []
+    for name, (kind, help, samples) in sorted(families.items()):
+        if help:
+            lines.append(f"# HELP {name} {_escape_help(help)}")
+        lines.append(f"# TYPE {name} {kind}")
+        for labels, value in samples:
+            lk = _label_key(labels)
+            if kind == "histogram" and isinstance(value, dict):
+                for le, cum in value["buckets"].items():
+                    blk = (lk + "," if lk else "") + f'le="{le}"'
+                    lines.append(f"{name}_bucket{{{blk}}} {cum}")
+                binf = (lk + "," if lk else "") + 'le="+Inf"'
+                lines.append(
+                    f"{name}_bucket{{{binf}}} {value['count']}"
+                )
+                suffix = f"{{{lk}}}" if lk else ""
+                lines.append(f"{name}_sum{suffix} {value['sum']}")
+                lines.append(f"{name}_count{suffix} {value['count']}")
+            else:
+                suffix = f"{{{lk}}}" if lk else ""
+                lines.append(f"{name}{suffix} {value}")
+    return "\n".join(lines) + "\n"
 
 
 class MetricsRegistry:
@@ -370,34 +409,19 @@ class MetricsRegistry:
                     }
         return out
 
+    def families(self):
+        """One consistent pull of every family:
+        ``{name: (kind, help, [(labels_dict, value)])}`` — the shape
+        ``render_exposition`` renders and the ``GetMetrics`` bridge RPC
+        serializes (observability/telemetry.py)."""
+        with self.scrape_pass():
+            return self._families()
+
     def prometheus_text(self) -> str:
         """Prometheus text exposition format 0.0.4. Each family appears
         EXACTLY once (one ``# TYPE`` line, then every labeled sample) —
         the invariant the bench smoke test pins."""
-        lines: List[str] = []
-        with self.scrape_pass():
-            families = sorted(self._families().items())
-        for name, (kind, help, samples) in families:
-            if help:
-                lines.append(f"# HELP {name} {help}")
-            lines.append(f"# TYPE {name} {kind}")
-            for labels, value in samples:
-                lk = _label_key(labels)
-                if kind == "histogram" and isinstance(value, dict):
-                    for le, cum in value["buckets"].items():
-                        blk = (lk + "," if lk else "") + f'le="{le}"'
-                        lines.append(f"{name}_bucket{{{blk}}} {cum}")
-                    binf = (lk + "," if lk else "") + 'le="+Inf"'
-                    lines.append(
-                        f"{name}_bucket{{{binf}}} {value['count']}"
-                    )
-                    suffix = f"{{{lk}}}" if lk else ""
-                    lines.append(f"{name}_sum{suffix} {value['sum']}")
-                    lines.append(f"{name}_count{suffix} {value['count']}")
-                else:
-                    suffix = f"{{{lk}}}" if lk else ""
-                    lines.append(f"{name}{suffix} {value}")
-        return "\n".join(lines) + "\n"
+        return render_exposition(self.families())
 
 
 # THE process registry: instruments register here at module import, the

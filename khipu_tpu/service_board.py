@@ -64,6 +64,8 @@ class ServiceBoard:
         self._cluster = None
         self._cluster_health = None
         self._serving = None
+        self._telemetry = None
+        self._watchdog = None
 
     # ---------------------------------------------------------- node key
 
@@ -108,6 +110,7 @@ class ServiceBoard:
                 if self._serving is not None else None
             ),
             serving=self._serving,
+            telemetry=self._telemetry,
         )
         extra = ()
         keystore_dir = key_dir or (
@@ -240,6 +243,7 @@ class ServiceBoard:
         plane)."""
         from khipu_tpu.serving import ServingPlane
 
+        kwargs.setdefault("telemetry", self._telemetry)
         self._serving = ServingPlane.build(
             self.blockchain, self.config, tx_pool=self.tx_pool,
             **kwargs,
@@ -249,6 +253,59 @@ class ServiceBoard:
     @property
     def serving(self):
         return self._serving
+
+    def start_telemetry(self, endpoints=None):
+        """Stand up the cluster telemetry plane
+        (observability/telemetry.py — docs/observability.md): a
+        ``ClusterTelemetry`` poller scraping every shard's registry over
+        the ``GetMetrics`` bridge RPC, plus the pipeline stall
+        ``Watchdog``. Returns ``None`` when
+        ``config.telemetry.enabled`` is False — the zero-cost contract:
+        no threads, no RPCs, bit-exact replay.
+
+        Call AFTER ``start_cluster`` (breaker state feeds the health
+        score) and around ``start_serving`` in either order — an
+        existing serving plane gains the cluster-pressure signal here;
+        a later ``start_serving`` should pass
+        ``telemetry=board.telemetry``."""
+        tc = self.config.telemetry
+        if not tc.enabled:
+            return None
+        from khipu_tpu.observability.telemetry import (
+            ClusterTelemetry,
+            Watchdog,
+        )
+
+        eps = tuple(
+            endpoints if endpoints is not None
+            else self.config.cluster.endpoints
+        )
+        self._telemetry = ClusterTelemetry(
+            eps, config=tc, cluster=self._cluster, tracer=self.tracer,
+        )
+        self._telemetry.start()
+        if tc.watchdog:
+            self._watchdog = Watchdog(
+                config=tc,
+                journal_depth=(
+                    (lambda: self.storages.window_journal.depth)
+                    if self.config.sync.commit_journal else None
+                ),
+                telemetry=self._telemetry,
+                tracer=self.tracer,
+            )
+            self._watchdog.start()
+        if self._serving is not None:
+            from khipu_tpu.serving import cluster_pressure
+
+            self._serving.admission.add_signal(
+                cluster_pressure(self._telemetry)
+            )
+        return self._telemetry
+
+    @property
+    def telemetry(self):
+        return self._telemetry
 
     def start_regular_sync(self, **kwargs):
         """Tip-following block import over the peer pool
@@ -296,7 +353,8 @@ class ServiceBoard:
         storages flushed+closed last."""
         for svc in (self._rpc_server, self._bridge_server,
                     self._peer_manager, self._discovery,
-                    self._cluster_health):
+                    self._cluster_health, self._watchdog,
+                    self._telemetry):
             if svc is not None:
                 try:
                     svc.stop()

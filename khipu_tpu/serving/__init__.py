@@ -28,6 +28,7 @@ from khipu_tpu.serving.admission import (
     AdmissionController,
     ServerBusy,
     classify_method,
+    cluster_pressure,
     journal_pressure,
     pipeline_pressure,
     txpool_pressure,
@@ -43,6 +44,7 @@ __all__ = [
     "SloPolicy",
     "SloTracker",
     "classify_method",
+    "cluster_pressure",
     "journal_pressure",
     "pipeline_pressure",
     "txpool_pressure",
@@ -78,11 +80,13 @@ class ServingPlane:
         config: Optional[KhipuConfig] = None,
         tx_pool=None,
         extra_signals: Optional[List[Callable[[], float]]] = None,
+        telemetry=None,
     ) -> "ServingPlane":
         """The standard wiring (what ``ServiceBoard.start_serving``
         calls): a ReadView over ``blockchain`` plus admission fed by
         every pressure signal the node can report — window-pipeline
-        occupancy, commit-journal depth, txpool fill."""
+        occupancy, commit-journal depth, txpool fill, and (when a
+        ``ClusterTelemetry`` is attached) worst-shard cluster health."""
         cfg = config or KhipuConfig()
         signals: List[Callable[[], float]] = [pipeline_pressure()]
         if cfg.sync.commit_journal:
@@ -91,6 +95,8 @@ class ServingPlane:
             ))
         if tx_pool is not None:
             signals.append(txpool_pressure(tx_pool))
+        if telemetry is not None:
+            signals.append(cluster_pressure(telemetry))
         signals.extend(extra_signals or [])
         return cls(
             config=cfg.serving,

@@ -187,6 +187,10 @@ class AdmissionController:
             for name in COST_CLASSES
         }
         self.signals = list(signals or [])
+        # per-signal shed attribution: when a pressure shed fires, the
+        # ARGMAX signal gets the blame — "we shed 400 writes" is
+        # useless without "because the cluster was dying"
+        self.shed_by_signal: Dict[str, int] = {}
         # >1 disables: pressure is clamped to [0,1] so it never trips
         self._shed_at = {
             "cheap": 2.0,
@@ -198,16 +202,32 @@ class AdmissionController:
 
     # ---------------------------------------------------------- pressure
 
-    def pressure(self) -> float:
-        p = 0.0
-        for sig in self.signals:
+    def add_signal(self, signal: Callable[[], float]) -> None:
+        """Attach a pressure signal after construction (the telemetry
+        plane starts later than the serving plane)."""
+        self.signals.append(signal)
+
+    def pressure_detail(self) -> tuple:
+        """``(pressure, {signal_name: value})`` — the max AND the
+        per-signal breakdown behind it. Signal names come from the
+        factory-stamped ``signal_name`` attribute (``sig_N`` for
+        anonymous callables)."""
+        detail: Dict[str, float] = {}
+        p, worst = 0.0, None
+        for i, sig in enumerate(self.signals):
             try:
                 v = sig()
             except Exception:
                 continue  # a broken signal must not take serving down
-            if v > p:
-                p = v
-        return min(1.0, max(0.0, p))
+            name = getattr(sig, "signal_name", f"sig_{i}")
+            detail[name] = min(1.0, max(0.0, v))
+            if v > p or worst is None:
+                p, worst = max(p, v), name
+        return min(1.0, max(0.0, p)), detail
+
+    def pressure(self) -> float:
+        p, _detail = self.pressure_detail()
+        return p
 
     # ----------------------------------------------------------- acquire
 
@@ -215,12 +235,16 @@ class AdmissionController:
         """Admission ticket ``(limiter, t0)`` or :class:`ServerBusy`."""
         cls = self._classes[classify_method(method)]
         if self.signals and self._shed_at[cls.name] <= 1.0:
-            p = self.pressure()
+            p, detail = self.pressure_detail()
             if p >= self._shed_at[cls.name]:
                 cls.shed_pressure += 1
+                blame = max(detail, key=detail.get) if detail else "none"
+                self.shed_by_signal[blame] = (
+                    self.shed_by_signal.get(blame, 0) + 1
+                )
                 raise ServerBusy(
                     f"server busy: load shed ({cls.name} class, "
-                    f"pressure {p:.2f})"
+                    f"pressure {p:.2f}, signal {blame})"
                 )
         if not cls.acquire():
             raise ServerBusy(
@@ -253,7 +277,12 @@ class AdmissionController:
                     "pressure": cls.shed_pressure,
                 },
             }
-        out["pressure"] = round(self.pressure(), 4)
+        pressure, detail = self.pressure_detail()
+        out["pressure"] = round(pressure, 4)
+        out["pressureBySignal"] = {
+            k: round(v, 4) for k, v in detail.items()
+        }
+        out["shedBySignal"] = dict(self.shed_by_signal)
         return out
 
     def _registry_samples(self) -> list:
@@ -276,9 +305,20 @@ class AdmissionController:
                     "khipu_admission_shed_total", "counter",
                     {"class": name, "reason": reason}, v,
                 ))
+        pressure, detail = self.pressure_detail()
         samples.append(
-            ("khipu_admission_pressure", "gauge", {}, self.pressure())
+            ("khipu_admission_pressure", "gauge", {}, round(pressure, 4))
         )
+        for sig, v in sorted(detail.items()):
+            samples.append((
+                "khipu_admission_signal_pressure", "gauge",
+                {"signal": sig}, round(v, 4),
+            ))
+        for sig, v in sorted(self.shed_by_signal.items()):
+            samples.append((
+                "khipu_admission_shed_by_signal_total", "counter",
+                {"signal": sig}, v,
+            ))
         return samples
 
 
@@ -295,6 +335,7 @@ def pipeline_pressure() -> Callable[[], float]:
         depth = PIPELINE_GAUGES["depth"] or 1
         return PIPELINE_GAUGES["in_flight"] / (depth + 1)
 
+    signal.signal_name = "pipeline"
     return signal
 
 
@@ -310,6 +351,7 @@ def journal_pressure(storages, pipeline_depth: int = 2) -> Callable[[], float]:
         except Exception:
             return 0.0
 
+    signal.signal_name = "journal"
     return signal
 
 
@@ -317,4 +359,20 @@ def txpool_pressure(pool) -> Callable[[], float]:
     def signal() -> float:
         return len(pool) / max(1, pool.capacity)
 
+    signal.signal_name = "txpool"
+    return signal
+
+
+def cluster_pressure(telemetry) -> Callable[[], float]:
+    """Per-shard health folded into admission (the ROADMAP seam:
+    "feed admission from per-shard health instead of local signals
+    only"): ``telemetry`` is a ``ClusterTelemetry``; its ``pressure()``
+    is worst-shard unhealth, so overload or death on ANY replica set
+    sheds writes at the driver before queues back up behind a dying
+    shard."""
+
+    def signal() -> float:
+        return telemetry.pressure()
+
+    signal.signal_name = "cluster"
     return signal

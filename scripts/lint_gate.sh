@@ -11,10 +11,27 @@
 #
 # Usage:
 #   scripts/lint_gate.sh [paths...] [--format=json] [...]
+#   scripts/lint_gate.sh --annotate [paths...]
+#
+# --annotate is the review-tooling mode: findings print as
+# 'file:line: [KL00x] msg' lines and the SARIF-ish JSON document lands
+# at $KHIPU_LINT_ARTIFACT (default /tmp/khipu_lint_findings.json).
 #
 # Pure stdlib — no jax import, runs in milliseconds anywhere.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
-python -m khipu_tpu.analysis "${@:-khipu_tpu}"
+args=()
+for a in "$@"; do
+  if [ "$a" = "--annotate" ]; then
+    args+=(--annotate "${KHIPU_LINT_ARTIFACT:-/tmp/khipu_lint_findings.json}")
+  else
+    args+=("$a")
+  fi
+done
+if [ ${#args[@]} -eq 0 ]; then
+  args=(khipu_tpu)
+fi
+
+python -m khipu_tpu.analysis ${args[@]+"${args[@]}"}

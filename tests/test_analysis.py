@@ -346,6 +346,35 @@ class TestReportAndCli:
         assert r.returncode == 1
         assert json.loads(r.stdout)["runs"][0]["results"]
 
+    def test_annotations_render_and_cli_annotate(self, tmp_path):
+        """--annotate (review-tooling mode, the PR-10 satellite):
+        findings print as ``file:line: [KL00x] msg`` lines and the
+        SARIF-ish JSON document lands at the given path."""
+        from khipu_tpu.analysis.report import render_annotations
+
+        findings = _scan(tmp_path, {"mod.py": (
+            "def f(x=[]):\n    return x\n"
+        )})
+        ann = render_annotations(findings)
+        first = ann.splitlines()[0]
+        assert first.endswith(findings[0].message)
+        assert f":{findings[0].line}: [KL006] " in first
+        assert first.startswith(findings[0].path)
+
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(x=[]):\n    return x\n")
+        artifact = tmp_path / "findings.json"
+        r = subprocess.run(
+            [sys.executable, "-m", "khipu_tpu.analysis", str(bad),
+             "--no-baseline", "--annotate", str(artifact)],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+        )
+        assert r.returncode == 1
+        assert f"{bad}:1: [KL006]" in r.stdout, r.stdout
+        assert str(artifact) in r.stdout  # artifact path announced
+        doc = json.loads(artifact.read_text())
+        assert doc["runs"][0]["results"][0]["ruleId"] == "KL006"
+
     def test_cli_rules_filter(self, tmp_path):
         bad = tmp_path / "bad.py"
         bad.write_text("def f(x=[]):\n    return x\n")

@@ -547,6 +547,46 @@ class TestRegistry:
         assert "h_seconds_count 2" in lines
         assert any(ln.startswith("h_seconds_sum ") for ln in lines)
 
+    def test_exposition_escaping_hostile_values_round_trip(self):
+        """Exposition-format escaping audit (the PR-10 satellite):
+        backslash, double-quote, and newline in label VALUES and
+        backslash/newline in HELP text must round-trip per format
+        0.0.4 — a label value containing a literal ``\\n`` used to be
+        able to smuggle a fake sample line into the document."""
+        from khipu_tpu.observability.registry import MetricsRegistry
+
+        hostile = 'a\\b"c\nd'
+        r = MetricsRegistry()
+        r.gauge("g", labels={"ep": hostile}).set(1)
+        r.counter(
+            "c_total", help='back\\slash and\nnewline "quoted"'
+        ).inc(2)
+        text = r.prometheus_text()
+        lines = text.splitlines()
+        # label value: \ -> \\, " -> \", newline -> \n (no raw newline
+        # survives inside a sample line)
+        assert 'g{ep="a\\\\b\\"c\\nd"} 1' in lines, lines
+        # HELP: \ -> \\, newline -> \n, quotes stay verbatim
+        assert (
+            '# HELP c_total back\\\\slash and\\nnewline "quoted"'
+            in lines
+        ), lines
+        # nothing hostile injected a bogus line: every line is a
+        # comment or starts with a known family name
+        for ln in lines:
+            assert ln.startswith(("#", "g{", "c_total")), ln
+        # and the escapes DECODE back to the original strings under
+        # the format's unescape rules (round-trip, not just mangling)
+        sample = next(ln for ln in lines if ln.startswith("g{"))
+        raw = sample[len('g{ep="'):sample.rindex('"')]
+        unescaped = (
+            raw.replace("\\\\", "\x00")
+            .replace('\\"', '"')
+            .replace("\\n", "\n")
+            .replace("\x00", "\\")
+        )
+        assert unescaped == hostile
+
     def test_process_registry_serves_migrated_families(self):
         """The legacy dicts (PIPELINE_GAUGES, WINDOW_GAUGES, chaos
         fault log, tracer ring health) all surface as families of THE

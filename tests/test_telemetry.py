@@ -1,0 +1,910 @@
+"""Cluster telemetry plane (the PR-10 tentpole): GetMetrics federation
+codec, shard-labeled merge semantics, per-shard health scoring feeding
+admission, and the pipeline stall watchdog
+(khipu_tpu/observability/telemetry.py — docs/observability.md).
+
+The headline scenarios: a 2-shard bridge cluster whose merged
+exposition carries ``shard`` labels under one TYPE line per family;
+killing a shard drives ``khipu_shard_up`` to 0 and the health score
+under the threshold within ONE scrape, the cluster-pressure admission
+signal sheds writes (with ``cluster`` blamed), and a healed shard
+restores admission; a chaos-injected ``collector.persist`` latency
+trips ``watchdog.stage_stall`` into the chrome trace while a clean run
+— and a 120-seed synthetic gauge sweep — trips NOTHING.
+"""
+
+import dataclasses
+import threading
+import time
+from random import Random
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.chaos import FaultPlan, FaultRule, active
+from khipu_tpu.config import (
+    ServingConfig,
+    SyncConfig,
+    TelemetryConfig,
+    fixture_config,
+)
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.observability import export
+from khipu_tpu.observability.registry import MetricsRegistry
+from khipu_tpu.observability.telemetry import (
+    WATCHDOG_KINDS,
+    ClusterTelemetry,
+    HealthScore,
+    Watchdog,
+    decode_metrics,
+    encode_metrics,
+)
+from khipu_tpu.observability.trace import Tracer
+from khipu_tpu.serving import ServerBusy
+from khipu_tpu.serving.admission import (
+    AdmissionController,
+    cluster_pressure,
+)
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.sync.replay import PIPELINE_GAUGES, ReplayDriver
+
+CFG = fixture_config(chain_id=1)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(3)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ALLOC = {a: 10**21 for a in ADDRS}
+
+
+# ----------------------------------------------------------- test rigs
+
+
+class FakeMetricsClient:
+    """In-process stand-in for BridgeClient.get_metrics: serves a real
+    registry THROUGH the wire codec, with scripted failures."""
+
+    def __init__(self, registry):
+        self.registry = registry
+        self.fail = False
+        self.closed = False
+        self.calls = 0
+
+    def get_metrics(self):
+        self.calls += 1
+        if self.fail:
+            raise ConnectionError("shard down")
+        return decode_metrics(encode_metrics(self.registry))
+
+    def close(self):
+        self.closed = True
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _shard_registry(inflight=0):
+    reg = MetricsRegistry()
+    reg.gauge("khipu_pipeline_in_flight").set(inflight)
+    return reg
+
+
+def _telemetry(shards, clock=None, cluster=None, **cfg_kw):
+    """ClusterTelemetry over FakeMetricsClient shards, on a private
+    driver registry and (by default) a controlled clock."""
+    cfg_kw.setdefault("enabled", True)
+    cfg_kw.setdefault("scrape_interval", 1.0)
+    cfg_kw.setdefault("staleness_s", 3.0)
+    tel = ClusterTelemetry(
+        list(shards),
+        config=TelemetryConfig(**cfg_kw),
+        client_factory=lambda ep: shards[ep],
+        cluster=cluster,
+        registry=MetricsRegistry(),
+        clock=clock or FakeClock(),
+    )
+    return tel
+
+
+# ----------------------------------------------------------------- codec
+
+
+class TestMetricsCodec:
+    def test_round_trip_is_families(self):
+        """decode(encode(r)) == r.families() — counters, labeled
+        gauges, histograms; the merged view renders from the exact
+        shape a local registry would."""
+        r = MetricsRegistry()
+        r.counter("khipu_reqs_total", help="requests").inc(7)
+        r.gauge("khipu_depth", labels={"stage": "persist"}).set(3)
+        h = r.histogram(
+            "khipu_lat_seconds", buckets=(0.01, 0.1, 1.0)
+        )
+        h.observe(0.05)
+        h.observe(0.5)
+        assert decode_metrics(encode_metrics(r)) == r.families()
+
+    def test_histogram_bucket_keys_stay_floats(self):
+        """Bucket bounds ride through JSON as strings; the decoder
+        must restore float ``le`` keys or merged rendering diverges
+        from local rendering."""
+        r = MetricsRegistry()
+        r.histogram("khipu_h", buckets=(0.5, 2.0)).observe(1.0)
+        fams = decode_metrics(encode_metrics(r))
+        _kind, _help, samples = fams["khipu_h"]
+        buckets = samples[0][1]["buckets"]
+        assert all(isinstance(k, float) for k in buckets)
+        assert buckets == {0.5: 0, 2.0: 1}
+
+    def test_hostile_label_values_survive(self):
+        hostile = 'a\\b"c\nd'
+        r = MetricsRegistry()
+        r.gauge("khipu_g", labels={"ep": hostile}).set(1.5)
+        fams = decode_metrics(encode_metrics(r))
+        assert fams["khipu_g"][2] == [({"ep": hostile}, 1.5)]
+
+    def test_empty_registry(self):
+        assert decode_metrics(encode_metrics(MetricsRegistry())) == {}
+
+
+# ----------------------------------------------------------------- merge
+
+
+class TestMergedExposition:
+    def test_shard_labels_and_one_type_line(self):
+        shards = {
+            "a:1": FakeMetricsClient(_shard_registry(2)),
+            "b:1": FakeMetricsClient(_shard_registry(5)),
+        }
+        tel = _telemetry(shards)
+        assert tel.scrape_once() == 2
+        fams = tel.merged_families()
+        samples = dict(
+            (lb["shard"], v)
+            for lb, v in fams["khipu_pipeline_in_flight"][2]
+        )
+        assert samples == {"a:1": 2, "b:1": 5}  # per-shard, NOT summed
+        text = tel.cluster_text()
+        lines = text.splitlines()
+        assert lines.count(
+            "# TYPE khipu_pipeline_in_flight gauge"
+        ) == 1
+        assert 'khipu_pipeline_in_flight{shard="a:1"} 2' in lines
+        assert 'khipu_pipeline_in_flight{shard="b:1"} 5' in lines
+
+    def test_aligned_histograms_sum_bucketwise(self):
+        regs = {}
+        for ep, vals in (("a:1", (0.05,)), ("b:1", (0.5, 0.05))):
+            reg = MetricsRegistry()
+            h = reg.histogram("khipu_lat", buckets=(0.1, 1.0))
+            for v in vals:
+                h.observe(v)
+            regs[ep] = reg
+        tel = _telemetry(
+            {ep: FakeMetricsClient(r) for ep, r in regs.items()}
+        )
+        tel.scrape_once()
+        fams = tel.merged_families()
+        samples = fams["khipu_lat"][2]
+        assert len(samples) == 1  # ONE merged family, unlabeled
+        labels, v = samples[0]
+        assert "shard" not in labels
+        assert v["count"] == 3
+        assert v["sum"] == pytest.approx(0.6)
+        assert v["buckets"] == {0.1: 2, 1.0: 3}
+        assert tel.bucket_mismatches == 0
+
+    def test_mismatched_buckets_degrade_per_shard(self):
+        """Different bounds: summing would lie about the distribution
+        — degrade to shard-labeled series and count the mismatch."""
+        regs = {}
+        for ep, bounds in (("a:1", (0.1, 1.0)), ("b:1", (0.5, 2.0))):
+            reg = MetricsRegistry()
+            reg.histogram("khipu_lat", buckets=bounds).observe(0.3)
+            regs[ep] = reg
+        tel = _telemetry(
+            {ep: FakeMetricsClient(r) for ep, r in regs.items()}
+        )
+        tel.scrape_once()
+        fams = tel.merged_families()
+        shards = sorted(lb["shard"] for lb, _ in fams["khipu_lat"][2])
+        assert shards == ["a:1", "b:1"]
+        assert tel.bucket_mismatches == 1
+        # ... and the driver registry exports the counter
+        text = tel.registry.prometheus_text()
+        assert "khipu_telemetry_bucket_mismatch_total 1" in text
+
+    def test_stale_shard_ages_out(self):
+        """A shard whose last good scrape exceeds staleness_s stops
+        contributing samples — stale truth is worse than absence."""
+        clock = FakeClock()
+        shards = {
+            "a:1": FakeMetricsClient(_shard_registry(1)),
+            "b:1": FakeMetricsClient(_shard_registry(9)),
+        }
+        tel = _telemetry(shards, clock=clock, staleness_s=3.0)
+        tel.scrape_once()  # both good at t=0
+        shards["b:1"].fail = True
+        clock.t = 2.0
+        tel.scrape_once()  # a refreshed, b's families stay from t=0
+        in_flight = {
+            lb["shard"]
+            for lb, _ in tel.merged_families()[
+                "khipu_pipeline_in_flight"
+            ][2]
+        }
+        assert in_flight == {"a:1", "b:1"}  # b stale-but-within-limit
+        clock.t = 4.0  # b's data now 4s old > 3s staleness; a's 2s
+        in_flight = {
+            lb["shard"]
+            for lb, _ in tel.merged_families()[
+                "khipu_pipeline_in_flight"
+            ][2]
+        }
+        assert in_flight == {"a:1"}
+
+
+# ---------------------------------------------------------------- health
+
+
+class TestHealthScore:
+    def test_healthy_fresh_shard_scores_one(self):
+        clock = FakeClock()
+        tel = _telemetry(
+            {"a:1": FakeMetricsClient(_shard_registry())}, clock=clock
+        )
+        tel.scrape_once()
+        hs = tel.health_scores()["a:1"]
+        assert hs.score == 1.0
+        assert hs.components == {
+            "freshness": 1.0, "breaker": 1.0,
+            "errors": 1.0, "latency": 1.0,
+        }
+        assert tel.pressure() == 0.0  # exactly — the weights sum to 1
+
+    def test_never_scraped_is_optimistic(self):
+        """Starting the plane must never shed traffic by itself."""
+        tel = _telemetry({"a:1": FakeMetricsClient(_shard_registry())})
+        assert tel.health_scores()["a:1"].score == 1.0
+        assert tel.pressure() == 0.0
+
+    def test_unreachable_scores_zero_within_one_scrape(self):
+        shard = FakeMetricsClient(_shard_registry())
+        tel = _telemetry({"a:1": shard})
+        tel.scrape_once()
+        shard.fail = True
+        tel.scrape_once()  # ONE failed scrape is enough
+        hs = tel.health_scores()["a:1"]
+        assert hs.score == 0.0
+        assert tel.pressure() == 1.0
+        rep = tel.report()["shards"]["a:1"]
+        assert rep["up"] is False and rep["degraded"] is True
+        assert "ConnectionError" in rep["lastError"]
+
+    def test_freshness_decays_linearly_to_staleness(self):
+        clock = FakeClock()
+        tel = _telemetry(
+            {"a:1": FakeMetricsClient(_shard_registry())},
+            clock=clock, scrape_interval=1.0, staleness_s=3.0,
+        )
+        tel.scrape_once()
+        clock.t = 1.0  # within one interval: still perfectly fresh
+        assert tel.health_scores()["a:1"].score == 1.0
+        clock.t = 2.0  # halfway from interval to staleness
+        hs = tel.health_scores()["a:1"]
+        assert hs.components["freshness"] == pytest.approx(0.5)
+        assert hs.score == pytest.approx(0.8)  # 0.4*0.5 + 0.3+0.2+0.1
+        clock.t = 3.0  # at staleness: freshness fully gone
+        assert tel.health_scores()["a:1"].score == pytest.approx(0.6)
+
+    def test_breaker_state_feeds_the_score(self):
+        class _Breaker:
+            def __init__(self, state):
+                self.state = state
+
+        class _Cluster:
+            breakers = {"a:1": _Breaker("open")}
+
+        clock = FakeClock()
+        tel = _telemetry(
+            {"a:1": FakeMetricsClient(_shard_registry())},
+            clock=clock, cluster=_Cluster(),
+        )
+        tel.scrape_once()
+        hs = tel.health_scores()["a:1"]
+        assert hs.components["breaker"] == 0.0
+        assert hs.score == pytest.approx(0.7)  # 0.4 + 0 + 0.2 + 0.1
+        _Cluster.breakers["a:1"].state = "half-open"
+        assert tel.health_scores()["a:1"].score == pytest.approx(0.85)
+
+    def test_recovery_climbs_back_above_threshold(self):
+        shard = FakeMetricsClient(_shard_registry())
+        tel = _telemetry({"a:1": shard}, health_threshold=0.5)
+        tel.scrape_once()
+        shard.fail = True
+        tel.scrape_once()
+        assert tel.pressure() == 1.0
+        shard.fail = False
+        tel.scrape_once()
+        hs = tel.health_scores()["a:1"]
+        # errors component remembers the blip (2/3 of recent attempts
+        # succeeded) but the shard is comfortably healthy again
+        assert hs.components["errors"] == pytest.approx(2 / 3)
+        assert hs.score > 0.9
+        assert tel.report()["shards"]["a:1"]["degraded"] is False
+
+    def test_report_key_gauges_and_registry_exports(self):
+        shard = FakeMetricsClient(_shard_registry(inflight=4))
+        tel = _telemetry(
+            {"a:1": shard},
+            key_gauges=("khipu_pipeline_in_flight",),
+        )
+        tel.scrape_once()
+        rep = tel.report()
+        assert rep["shards"]["a:1"]["keyGauges"] == {
+            "khipu_pipeline_in_flight": 4
+        }
+        assert rep["scrapes"] == 1 and rep["scrapeFailures"] == 0
+        text = tel.registry.prometheus_text()
+        assert 'khipu_shard_health{endpoint="a:1"} 1.0' in text
+        assert "khipu_telemetry_scrapes_total 1" in text
+
+    def test_admission_sheds_writes_on_cluster_pressure(self):
+        """The ROADMAP seam: worst-shard unhealth wired straight into
+        the admission controller — writes shed with ``cluster``
+        blamed, cheap reads keep flowing."""
+        shard = FakeMetricsClient(_shard_registry())
+        tel = _telemetry({"a:1": shard})
+        tel.scrape_once()
+        adm = AdmissionController(
+            ServingConfig(), signals=[cluster_pressure(tel)],
+            registry=MetricsRegistry(),
+        )
+        ticket = adm.acquire("eth_sendRawTransaction")  # healthy: in
+        adm.release(ticket)
+        shard.fail = True
+        tel.scrape_once()
+        with pytest.raises(ServerBusy, match="signal cluster"):
+            adm.acquire("eth_sendRawTransaction")
+        assert adm.shed_by_signal == {"cluster": 1}
+        # cheap class never sheds on pressure (threshold > 1)
+        adm.release(adm.acquire("eth_chainId"))
+        snap = adm.snapshot()
+        assert snap["pressureBySignal"]["cluster"] == 1.0
+        assert snap["shedBySignal"] == {"cluster": 1}
+
+
+# ---------------------------------------------------------- poller thread
+
+
+class TestPoller:
+    def test_background_scrapes_and_clean_stop(self):
+        shard = FakeMetricsClient(_shard_registry())
+        tel = ClusterTelemetry(
+            ["a:1"],
+            config=TelemetryConfig(
+                enabled=True, scrape_interval=0.02, staleness_s=1.0
+            ),
+            client_factory=lambda ep: shard,
+            registry=MetricsRegistry(),
+        )
+        tel.start()
+        tel.start()  # idempotent
+        try:
+            deadline = time.time() + 5
+            while shard.calls < 2 and time.time() < deadline:
+                time.sleep(0.01)
+            assert shard.calls >= 2
+        finally:
+            tel.stop()
+        assert shard.closed
+        before = shard.calls
+        time.sleep(0.08)
+        assert shard.calls == before  # the thread is really gone
+
+    def test_failing_shard_never_kills_the_poller(self):
+        shard = FakeMetricsClient(_shard_registry())
+        shard.fail = True
+        tel = ClusterTelemetry(
+            ["a:1"],
+            config=TelemetryConfig(
+                enabled=True, scrape_interval=0.02, staleness_s=1.0
+            ),
+            client_factory=lambda ep: shard,
+            registry=MetricsRegistry(),
+        )
+        tel.start()
+        try:
+            deadline = time.time() + 5
+            while shard.calls < 3 and time.time() < deadline:
+                time.sleep(0.01)
+            assert shard.calls >= 3  # kept polling through failures
+        finally:
+            tel.stop()
+        assert tel.scrape_failures >= 3
+
+
+# -------------------------------------------------------------- watchdog
+
+
+def _dog(gauges, clock=None, telemetry=None, tracer=None, **cfg_kw):
+    cfg_kw.setdefault("enabled", True)
+    cfg_kw.setdefault("stall_after_s", 5.0)
+    cfg_kw.setdefault("journal_runaway_depth", 8)
+    return Watchdog(
+        config=TelemetryConfig(**cfg_kw),
+        pipeline=gauges,
+        journal_depth=gauges.pop("_journal", None),
+        telemetry=telemetry,
+        tracer=tracer,
+        registry=MetricsRegistry(),
+        clock=clock or FakeClock(),
+    )
+
+
+class TestWatchdogUnit:
+    def test_stall_trips_once_and_rearms_on_progress(self):
+        g = {"stage_persist_depth": 1, "stage_persist_busy_s": 2.0}
+        dog = _dog(dict(g), stall_after_s=5.0)
+        assert dog.check_once(now=0.0) == []  # arming observation
+        assert dog.check_once(now=4.0) == []  # not stalled long enough
+        assert dog.check_once(now=5.0) == ["stage_stall"]
+        assert dog.check_once(now=20.0) == []  # edge-triggered: once
+        assert dog.trips["stage_stall"] == 1
+        kind, tags = dog.events[-1]
+        assert kind == "stage_stall" and tags["stage"] == "persist"
+        # progress (busy_s advanced) re-arms the detector
+        dog._pipeline["stage_persist_busy_s"] = 2.5
+        assert dog.check_once(now=21.0) == []
+        dog._pipeline["stage_persist_busy_s"] = 2.5  # flat again
+        assert dog.check_once(now=27.0) == ["stage_stall"]
+        assert dog.trips["stage_stall"] == 2
+
+    def test_empty_or_busy_stage_never_trips(self):
+        dog = _dog(
+            {"stage_collect_depth": 0, "stage_collect_busy_s": 1.0},
+            stall_after_s=1.0,
+        )
+        assert dog.check_once(now=0.0) == []
+        assert dog.check_once(now=100.0) == []  # empty: no work queued
+        dog._pipeline["stage_collect_depth"] = 3
+        for i in range(10):  # deep but ADVANCING: busy, not stalled
+            dog._pipeline["stage_collect_busy_s"] = float(i)
+            assert dog.check_once(now=110.0 + 10 * i) == []
+        assert dog.trips["stage_stall"] == 0
+
+    def test_journal_runaway_is_edge_triggered(self):
+        depth = {"d": 0}
+        dog = _dog(
+            {"_journal": lambda: depth["d"]}, journal_runaway_depth=2
+        )
+        assert dog.check_once(now=0.0) == []
+        depth["d"] = 3
+        assert dog.check_once(now=1.0) == ["journal_runaway"]
+        assert dog.check_once(now=2.0) == []  # still over: one trip
+        depth["d"] = 1  # drained below the bar: re-armed
+        assert dog.check_once(now=3.0) == []
+        depth["d"] = 5
+        assert dog.check_once(now=4.0) == ["journal_runaway"]
+        assert dog.trips["journal_runaway"] == 2
+
+    def test_scrape_dead_fires_per_newly_dead_shard(self):
+        clock = FakeClock()
+        shards = {
+            "a:1": FakeMetricsClient(_shard_registry()),
+            "b:1": FakeMetricsClient(_shard_registry()),
+        }
+        tel = _telemetry(shards, clock=clock)
+        tel.scrape_once()
+        dog = _dog({}, clock=clock, telemetry=tel)
+        assert dog.check_once() == []
+        shards["b:1"].fail = True
+        tel.scrape_once()
+        trips = dog.check_once()
+        assert trips == ["scrape_dead"]
+        assert dog.events[-1] == ("scrape_dead", {"endpoint": "b:1"})
+        assert dog.check_once() == []  # still dead: no re-fire
+        shards["b:1"].fail = False
+        tel.scrape_once()  # healed...
+        assert dog.check_once() == []
+        shards["b:1"].fail = True
+        tel.scrape_once()  # ...and dies AGAIN: a new episode
+        assert dog.check_once() == ["scrape_dead"]
+        assert dog.trips["scrape_dead"] == 2
+
+    def test_trips_family_exists_zero_valued(self):
+        """The khipu_watchdog_trips_total family is visible from the
+        first scrape (what dashboards and the bench pin key on), all
+        kinds zero until something trips."""
+        dog = _dog({})
+        text = dog.registry.prometheus_text()
+        for kind in WATCHDOG_KINDS:
+            assert (
+                f'khipu_watchdog_trips_total{{kind="{kind}"}} 0'
+                in text
+            )
+
+    def test_trip_emits_tracer_instant_event(self):
+        tracer = Tracer()
+        tracer.enable()
+        dog = _dog(
+            {"stage_save_depth": 2, "stage_save_busy_s": 0.0},
+            tracer=tracer, stall_after_s=1.0,
+        )
+        dog.check_once(now=0.0)
+        dog.check_once(now=1.0)
+        spans = [
+            s for s in tracer.snapshot()
+            if s.name == "watchdog.stage_stall"
+        ]
+        assert len(spans) == 1
+        doc = export.chrome_trace(spans=tracer.snapshot())
+        evts = [
+            e for e in doc["traceEvents"]
+            if e.get("name") == "watchdog.stage_stall"
+        ]
+        assert evts and evts[0]["ph"] == "i"  # chrome instant event
+
+    def test_clean_sweep_120_seeds_zero_trips(self):
+        """Synthetic healthy-pipeline traces across 120 seeds: depths
+        bounce around but busy_s ALWAYS advances while work is queued
+        — the starvation signature never appears, the dog never
+        barks. (The acceptance bar: a clean system is silent.)"""
+        for seed in range(120):
+            rng = Random(seed)
+            g = {}
+            busy = {s: 0.0 for s in ("collect", "persist", "save")}
+            dog = _dog(g, stall_after_s=2.0)
+            now = 0.0
+            for _ in range(50):
+                now += rng.uniform(0.5, 3.0)
+                for s in busy:
+                    depth = rng.randint(0, 3)
+                    if depth > 0:
+                        busy[s] = round(
+                            busy[s] + rng.uniform(0.001, 0.5), 3
+                        )
+                    g[f"stage_{s}_depth"] = depth
+                    g[f"stage_{s}_busy_s"] = busy[s]
+                assert dog.check_once(now=now) == [], seed
+            assert dog.trips == {k: 0 for k in WATCHDOG_KINDS}
+
+    def test_background_thread_start_stop(self):
+        g = {"stage_persist_depth": 1, "stage_persist_busy_s": 1.0}
+        dog = Watchdog(
+            config=TelemetryConfig(
+                enabled=True, watchdog_interval=0.01,
+                stall_after_s=0.05,
+            ),
+            pipeline=g, registry=MetricsRegistry(),
+        )
+        dog.start()
+        dog.start()  # idempotent
+        try:
+            deadline = time.time() + 5
+            while not dog.trips["stage_stall"] and time.time() < deadline:
+                time.sleep(0.01)
+            assert dog.trips["stage_stall"] == 1
+        finally:
+            dog.stop()
+        assert dog._thread is None
+
+
+# ------------------------------------------------------- watchdog + chaos
+
+
+def _build_chain(n=8):
+    builder = ChainBuilder(
+        Blockchain(Storages(), CFG), CFG, GenesisSpec(alloc=ALLOC)
+    )
+    return [
+        builder.add_block(
+            [sign_transaction(
+                Transaction(i, 10**9, 21000, ADDRS[1], 5), KEYS[0],
+                chain_id=1,
+            )],
+            coinbase=b"\xaa" * 20,
+        )
+        for i in range(n)
+    ]
+
+
+def _pipelined_cfg():
+    return dataclasses.replace(
+        CFG,
+        sync=SyncConfig(
+            parallel_tx=False,
+            commit_window_blocks=2,
+            pipeline_depth=2,
+            collector_join_timeout=5.0,
+        ),
+    )
+
+
+def _reset_stage_gauges():
+    # PIPELINE_GAUGES is module-global; earlier tests leave residue
+    for s in ("collect", "persist", "save"):
+        PIPELINE_GAUGES[f"stage_{s}_depth"] = 0
+        PIPELINE_GAUGES[f"stage_{s}_busy_s"] = 0.0
+
+
+class TestWatchdogChaos:
+    def test_injected_persist_latency_trips_stage_stall(self):
+        """A chaos latency at ``collector.persist`` holds the persist
+        stage active with busy_s flat — the real watchdog thread,
+        polling the REAL pipeline gauges during a pipelined replay,
+        must trip ``stage_stall`` on the persist stage and land the
+        instant event in the chrome trace."""
+        chain = _build_chain()
+        cfg = _pipelined_cfg()
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc=ALLOC))
+        _reset_stage_gauges()
+        tracer = Tracer()
+        tracer.enable()
+        dog = Watchdog(
+            config=TelemetryConfig(
+                enabled=True, watchdog_interval=0.01,
+                stall_after_s=0.1,
+            ),
+            tracer=tracer, registry=MetricsRegistry(),
+        )
+        plan = FaultPlan(
+            seed=0,
+            rules=[FaultRule(
+                "collector.persist", "latency", latency_s=0.6,
+                times=1,
+            )],
+        )
+        dog.start()
+        try:
+            with active(plan):
+                ReplayDriver(bc, cfg).replay(chain)
+        finally:
+            dog.stop()
+        assert bc.best_block_number == len(chain)  # latency, not harm
+        assert dog.trips["stage_stall"] >= 1
+        stages = {
+            tags["stage"] for kind, tags in dog.events
+            if kind == "stage_stall"
+        }
+        assert "persist" in stages
+        doc = export.chrome_trace(spans=tracer.snapshot())
+        evts = [
+            e for e in doc["traceEvents"]
+            if e.get("name") == "watchdog.stage_stall"
+        ]
+        assert evts and all(e["ph"] == "i" for e in evts)
+
+    def test_clean_pipelined_replay_trips_nothing(self):
+        """Same rig, no fault: a healthy pipeline where every stage
+        finishes in well under stall_after_s keeps the dog silent."""
+        chain = _build_chain()
+        cfg = _pipelined_cfg()
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GenesisSpec(alloc=ALLOC))
+        _reset_stage_gauges()
+        dog = Watchdog(
+            config=TelemetryConfig(
+                enabled=True, watchdog_interval=0.01,
+                stall_after_s=2.0,
+            ),
+            registry=MetricsRegistry(),
+        )
+        dog.start()
+        try:
+            ReplayDriver(bc, cfg).replay(chain)
+        finally:
+            dog.stop()
+        assert bc.best_block_number == len(chain)
+        assert dog.trips == {k: 0 for k in WATCHDOG_KINDS}
+
+
+# ------------------------------------------------------- zero-cost gate
+
+
+class TestZeroCostDisabled:
+    def test_service_board_start_telemetry_returns_none(self, tmp_path):
+        from khipu_tpu.config import DbConfig
+        from khipu_tpu.service_board import ServiceBoard
+
+        cfg = dataclasses.replace(
+            fixture_config(chain_id=1),
+            db=DbConfig(engine="sqlite", data_dir=str(tmp_path)),
+        )
+        assert cfg.telemetry.enabled is False  # the default
+        board = ServiceBoard(cfg, GenesisSpec(alloc=ALLOC))
+        before = {t.name for t in threading.enumerate()}
+        try:
+            assert board.start_telemetry() is None
+            assert board.telemetry is None
+            assert board._watchdog is None
+            after = {t.name for t in threading.enumerate()}
+            assert after == before  # no poller, no dog
+            assert not any(
+                t.name in ("khipu-telemetry", "khipu-watchdog")
+                for t in threading.enumerate()
+            )
+        finally:
+            board.shutdown()
+
+
+# --------------------------------------------- 2-shard gRPC integration
+
+
+grpc = pytest.importorskip("grpc")
+
+from khipu_tpu.bridge import BridgeClient, BridgeServer  # noqa: E402
+
+
+def _start_metric_shard(inflight):
+    """A real bridge shard with its OWN registry (the PR-10
+    BridgeServer seam) pre-loaded with one gauge."""
+    bc = Blockchain(Storages(), CFG)
+    bc.load_genesis(GenesisSpec(alloc=ALLOC))
+    reg = MetricsRegistry()
+    reg.gauge("khipu_pipeline_in_flight").set(inflight)
+    server = BridgeServer(bc, CFG, registry=reg)
+    port = server.start(port=0)
+    return server, port, bc, reg
+
+
+class TestTwoShardCluster:
+    def test_kill_shed_heal_round_trip(self):
+        """The acceptance scenario end-to-end over real gRPC: merged
+        shard-labeled exposition; kill shard B → ``khipu_shard_up`` 0
+        and health 0.0 within one scrape → cluster pressure 1.0 →
+        writes shed with ``cluster`` blamed; restart B on the same
+        port → pressure back to baseline, writes admitted again."""
+        from khipu_tpu.cluster import HealthMonitor, ShardedNodeClient
+
+        srv_a, port_a, _bc_a, _reg_a = _start_metric_shard(2)
+        srv_b, port_b, bc_b, reg_b = _start_metric_shard(7)
+        ep_a, ep_b = f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}"
+        cl = ShardedNodeClient(
+            [ep_a, ep_b],
+            channel_factory=lambda ep: BridgeClient(ep, deadline=2.0),
+            sleep=lambda s: None,
+        )
+        mon = HealthMonitor(cl, down_after=1)
+        tel = ClusterTelemetry(
+            [ep_a, ep_b],
+            config=TelemetryConfig(
+                enabled=True, scrape_interval=2.0, staleness_s=6.0,
+                health_threshold=0.5,
+            ),
+            cluster=cl,
+            registry=MetricsRegistry(),
+        )
+        adm = AdmissionController(
+            ServingConfig(), signals=[cluster_pressure(tel)],
+            registry=MetricsRegistry(),
+        )
+        try:
+            # ---- healthy baseline: federation + admission open
+            assert tel.scrape_once() == 2
+            lines = tel.cluster_text().splitlines()
+            assert lines.count(
+                "# TYPE khipu_pipeline_in_flight gauge"
+            ) == 1
+            assert (
+                f'khipu_pipeline_in_flight{{shard="{ep_a}"}} 2'
+                in lines
+            )
+            assert (
+                f'khipu_pipeline_in_flight{{shard="{ep_b}"}} 7'
+                in lines
+            )
+            assert mon.probe_once() == {ep_a: True, ep_b: True}
+            adm.release(adm.acquire("eth_sendRawTransaction"))
+
+            # ---- kill shard B
+            srv_b.stop()
+            tel.scrape_once()  # ONE scrape is the reaction bar
+            assert tel.health_scores()[ep_b].score == 0.0
+            assert tel.health_scores()[ep_a].score > 0.9
+            assert tel.pressure() == 1.0
+            rep = tel.report()
+            assert rep["shards"][ep_b]["degraded"] is True
+            assert rep["shards"][ep_a]["degraded"] is False
+            assert mon.probe_once() == {ep_a: True, ep_b: False}
+            up = dict(
+                (lb["endpoint"], v)
+                for name, _k, lb, v in mon._registry_samples()
+                if name == "khipu_shard_up"
+            )
+            assert up == {ep_a: 1, ep_b: 0}
+            with pytest.raises(ServerBusy, match="signal cluster"):
+                adm.acquire("eth_sendRawTransaction")
+            assert adm.shed_by_signal == {"cluster": 1}
+            shed = adm.snapshot()["write"]["shed"]["pressure"]
+            assert shed == 1
+            # the dead shard ages out of the merged view; A remains
+            # (scrape ages are fresh, so only families gate it here)
+            in_flight = {
+                lb["shard"]
+                for lb, _ in tel.merged_families()[
+                    "khipu_pipeline_in_flight"
+                ][2]
+            }
+            assert ep_a in in_flight
+
+            # ---- heal: a new server process on the SAME port
+            srv_b2 = BridgeServer(bc_b, CFG, registry=reg_b)
+            srv_b2.start(port=port_b)
+            try:
+                # the cached gRPC channel reconnects with backoff —
+                # poll the scrape until the shard reads healthy
+                deadline = time.time() + 15
+                while (tel.health_scores()[ep_b].score <= 0.5
+                       and time.time() < deadline):
+                    tel.scrape_once()
+                    time.sleep(0.1)
+                assert tel.health_scores()[ep_b].score > 0.5
+                assert tel.pressure() < 0.5
+                assert mon.probe_once() == {ep_a: True, ep_b: True}
+                adm.release(adm.acquire("eth_sendRawTransaction"))
+                assert adm.shed_by_signal == {"cluster": 1}  # no more
+            finally:
+                srv_b2.stop()
+        finally:
+            tel.stop()
+            cl.close()
+            srv_a.stop()
+
+    def test_get_metrics_rpc_round_trips_histograms(self):
+        """The GetMetrics wire: a shard histogram arrives with float
+        bucket bounds and renders identically on the driver side."""
+        srv, port, _bc, reg = _start_metric_shard(0)
+        h = reg.histogram("khipu_lat", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        client = BridgeClient(f"127.0.0.1:{port}", deadline=5.0)
+        try:
+            fams = client.get_metrics()
+            assert fams == reg.families()
+            assert fams["khipu_lat"][2][0][1]["buckets"] == {
+                0.1: 1, 1.0: 2
+            }
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_eth_service_cluster_rpcs(self):
+        """khipu_cluster_metrics_text / khipu_cluster_report serve the
+        merged view; without telemetry attached they error cleanly."""
+        from khipu_tpu.jsonrpc.eth_service import EthService, RpcError
+
+        srv, port, _bc, _reg = _start_metric_shard(3)
+        ep = f"127.0.0.1:{port}"
+        tel = ClusterTelemetry(
+            [ep],
+            config=TelemetryConfig(
+                enabled=True, scrape_interval=2.0, staleness_s=6.0
+            ),
+            registry=MetricsRegistry(),
+        )
+        bc = Blockchain(Storages(), CFG)
+        bc.load_genesis(GenesisSpec(alloc=ALLOC))
+        try:
+            tel.scrape_once()
+            svc = EthService(bc, CFG, telemetry=tel)
+            text = svc.khipu_cluster_metrics_text()
+            assert f'khipu_pipeline_in_flight{{shard="{ep}"}} 3' in text
+            rep = svc.khipu_cluster_report()
+            assert rep["shards"][ep]["up"] is True
+            bare = EthService(bc, CFG)
+            with pytest.raises(RpcError, match="not enabled"):
+                bare.khipu_cluster_metrics_text()
+            with pytest.raises(RpcError, match="not enabled"):
+                bare.khipu_cluster_report()
+        finally:
+            tel.stop()
+            srv.stop()
