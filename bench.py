@@ -1480,9 +1480,128 @@ def bench_serve(smoke=False):
     )
 
 
+def bench_rebalance(smoke=False, deadline_s=120.0):
+    """``bench.py --rebalance``: elastic-membership smoke/bench — a
+    3-shard in-process cluster takes a 4th shard through the full
+    epoch-fenced join (plan / stream / cutover) and then retires an
+    original. Emits ``shard_boot_to_serving_seconds`` (join call to
+    the first content-verified read served BY the new endpoint) and
+    ``rebalance_keys_per_sec``. Runs under a HARD deadline on a worker
+    thread: a wedged cutover exits 1 instead of hanging the gate."""
+    import threading
+
+    from khipu_tpu.base.crypto.keccak import keccak256
+    from khipu_tpu.cluster import Rebalancer, ShardedNodeClient
+    from khipu_tpu.cluster.ring import _point
+
+    class _Shard:
+        def __init__(self):
+            self.store = {}
+
+        def get_node_data(self, hashes):
+            return {
+                h: self.store[h] for h in hashes if h in self.store
+            }
+
+        def put_node_data(self, nodes):
+            self.store.update(nodes)
+            return len(nodes)
+
+        def stream_node_data(self, ranges, cursor, count):
+            snap = dict(self.store)
+            keys = sorted(
+                k for k in snap
+                if cursor < k
+                and any(lo <= _point(k) < hi for lo, hi in ranges)
+            )
+            page = keys[:count]
+            done = len(keys) <= count
+            nxt = page[-1] if page else bytes(cursor)
+            return done, nxt, [(k, snap[k]) for k in page]
+
+        def ping(self, payload=b""):
+            return payload
+
+        def close(self):
+            pass
+
+    n_keys = 2_000 if smoke else 20_000
+    shards = {ep: _Shard() for ep in ("s0", "s1", "s2", "s3")}
+    client = ShardedNodeClient(
+        ["s0", "s1", "s2"],
+        channel_factory=lambda ep: shards[ep],
+        sleep=lambda s: None,
+    )
+    rb = Rebalancer(client, batch=384)
+    data = {}
+    for i in range(n_keys):
+        v = b"rebalance bench node %d" % i
+        data[keccak256(v)] = v
+    client.replicate(data)
+
+    result = {}
+
+    def drive():
+        t0 = time.perf_counter()
+        streamed = rb.join("s3")
+        t_join = time.perf_counter() - t0
+        # first verified read SERVED BY the new shard: pick a key the
+        # new epoch assigns to it and fetch through the client
+        served = None
+        for h, v in data.items():
+            if client.ring.replicas_for(h)[0] == "s3":
+                got = client.fetch([h])
+                assert got == {h: v}, "wrong bytes from joined shard"
+                served = h
+                break
+        assert served is not None, "new shard owns no primaries"
+        result["boot_to_serving_s"] = time.perf_counter() - t0
+        result["join_s"] = t_join
+        result["streamed"] = streamed
+        rb.retire("s0")
+        assert set(client.ring.members) == {"s1", "s2", "s3"}
+
+    worker = threading.Thread(target=drive, daemon=True)
+    worker.start()
+    worker.join(timeout=deadline_s)
+    if worker.is_alive() or "boot_to_serving_s" not in result:
+        print(
+            f"bench_rebalance: FAILED — join/retire did not complete "
+            f"within {deadline_s}s (state={rb.status()})",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    keys_per_sec = (
+        result["streamed"] / result["join_s"]
+        if result["join_s"] > 0 else 0.0
+    )
+    emit(
+        "shard_boot_to_serving_seconds",
+        round(result["boot_to_serving_s"], 4),
+        "seconds",
+        keys_streamed=result["streamed"],
+        epoch=client.ring.epoch,
+        note="join() call to the first content-verified read served "
+             "by the new shard (in-process transports)",
+    )
+    emit(
+        "rebalance_keys_per_sec",
+        round(keys_per_sec, 1),
+        "keys/s",
+        dataset_keys=n_keys,
+        batch=rb.batch,
+        completed=rb.completed,
+        aborts=rb.aborts,
+        moved_fraction=round(rb.last_moved_fraction, 4),
+    )
+
+
 def main() -> None:
     if "--serve" in sys.argv:
         bench_serve(smoke="--smoke" in sys.argv)
+        return
+    if "--rebalance" in sys.argv:
+        bench_rebalance(smoke="--smoke" in sys.argv)
         return
     compare_path = None
     thresholds = {}

@@ -55,8 +55,8 @@ LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
 REENTRANT_CTORS = {"RLock"}
 
 _RPC_ATTRS = {
-    "get_node_data", "put_node_data", "get_trace_spans",
-    "khipu_metrics", "window_report", "ping",
+    "get_node_data", "put_node_data", "stream_node_data",
+    "get_trace_spans", "khipu_metrics", "window_report", "ping",
 }
 _THREADISH = re.compile(r"thread|worker|collector|proc", re.I)
 _THREAD_NAMES = {"t", "w", "th"}
@@ -99,6 +99,8 @@ class ModuleScan:
         self.attr_types: Dict[str, Dict[str, str]] = {}
         self.module_locks: Dict[str, str] = {}
         self.classes: Dict[str, Set[str]] = {}  # class -> method names
+        # class -> base-class bindings as written ("Base", "mod.Base")
+        self.class_bases: Dict[str, List[str]] = {}
         self.functions: Dict[str, FuncInfo] = {}  # qualname -> info
 
 
@@ -176,6 +178,15 @@ class _Scanner:
                         b, (ast.FunctionDef, ast.AsyncFunctionDef)
                     )
                 }
+                bases: List[str] = []
+                for b in stmt.bases:
+                    if isinstance(b, ast.Name):
+                        bases.append(b.id)
+                    elif isinstance(b, ast.Attribute) and isinstance(
+                        b.value, ast.Name
+                    ):
+                        bases.append(f"{b.value.id}.{b.attr}")
+                s.class_bases[stmt.name] = bases
                 self._collect_class(stmt)
         # functions (including nested) get walked after lock discovery
         for stmt in tree.body:
@@ -330,6 +341,20 @@ class _Scanner:
                 if lock is not None and f.attr == "release":
                     held = [h for h in held if h != lock]
                     continue
+            # a function/method REFERENCE passed as an argument is a
+            # call edge too: the receiver may invoke it synchronously
+            # under the caller's held-set (registry collectors, the
+            # cluster client's ``_call(endpoint, op)`` trampoline,
+            # ``sorted(key=...)``) — conservative, like the rest of
+            # the analysis
+            for a in list(call.args) + [
+                kw.value for kw in call.keywords
+            ]:
+                aref = self._callable_arg_ref(a, cls)
+                if aref is not None:
+                    info.calls.append(
+                        (aref, tuple(held), call.lineno)
+                    )
             kind, desc = self._blocking_kind(call)
             if kind:
                 info.blocking.append(
@@ -340,6 +365,22 @@ class _Scanner:
             if ref is not None:
                 info.calls.append((ref, tuple(held), call.lineno))
         return held
+
+    def _callable_arg_ref(self, expr: ast.AST,
+                          cls: Optional[str]) -> Optional[tuple]:
+        """A bare name or ``self.attr`` passed as an argument. Names
+        that are plain data (locals, parameters) resolve to nothing
+        later; names that collide with a known function create an
+        over-approximate edge — acceptable for a may-analysis."""
+        if isinstance(expr, ast.Name):
+            return ("name", expr.id)
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            if expr.value.id == "self" and cls is not None:
+                return ("self", cls, expr.attr)
+            return ("dotted", expr.value.id, expr.attr)
+        return None
 
     def _blocking_kind(self, call: ast.Call) -> Tuple[str, str]:
         s = self.s
@@ -411,30 +452,63 @@ class LockOrderAnalysis:
 
     # ------------------------------------------------------- resolution
 
-    def _resolve_class_method(self, sc: _Scanner, binding: str,
-                              method: str) -> Optional[Tuple[str, str]]:
-        """Resolve ``binding`` (a class name as visible in ``sc``) and
-        a method on it to a function key."""
+    def _locate_class(
+        self, sc: _Scanner, binding: str
+    ) -> Optional[Tuple[_Scanner, str]]:
+        """Resolve a class binding as visible in ``sc`` to the scanner
+        + class name that DEFINE it (same module, a from-import, or a
+        ``mod.Cls`` dotted reference)."""
         s = sc.s
-        target_sc, cls_name = None, None
         if binding in s.classes:
-            target_sc, cls_name = sc, binding
-        elif binding in s.object_imports:
+            return sc, binding
+        if binding in s.object_imports:
             mod, orig = s.object_imports[binding]
             other = self.by_dotted.get(mod)
             if other is not None and orig in other.s.classes:
-                target_sc, cls_name = other, orig
-        elif "." in binding:
+                return other, orig
+        if "." in binding:
             head, tail = binding.split(".", 1)
             mod = s.module_imports.get(head)
             other = self.by_dotted.get(mod) if mod else None
             if other is not None and tail in other.s.classes:
-                target_sc, cls_name = other, tail
-        if target_sc is None:
-            return None
-        if method in target_sc.s.classes.get(cls_name, ()):
-            return (target_sc.s.path, f"{cls_name}.{method}")
+                return other, tail
         return None
+
+    def _method_on_class(self, sc: _Scanner, cls_name: str,
+                         method: str,
+                         visited: Set[Tuple[str, str]]
+                         ) -> Optional[Tuple[str, str]]:
+        """MRO-style lookup: the class's own method, else the first
+        base (left-to-right, depth-first) that defines it — bases
+        resolved across modules through the import maps, cycle-guarded.
+        A ``self.m()`` in a subclass thus reaches the inherited body,
+        whose lock usage then propagates into the caller's lockset."""
+        if (sc.s.path, cls_name) in visited:
+            return None
+        visited.add((sc.s.path, cls_name))
+        if method in sc.s.classes.get(cls_name, ()):
+            return (sc.s.path, f"{cls_name}.{method}")
+        for base in sc.s.class_bases.get(cls_name, ()):
+            located = self._locate_class(sc, base)
+            if located is None:
+                continue
+            out = self._method_on_class(
+                located[0], located[1], method, visited
+            )
+            if out is not None:
+                return out
+        return None
+
+    def _resolve_class_method(self, sc: _Scanner, binding: str,
+                              method: str) -> Optional[Tuple[str, str]]:
+        """Resolve ``binding`` (a class name as visible in ``sc``) and
+        a method on it — own or inherited — to a function key."""
+        located = self._locate_class(sc, binding)
+        if located is None:
+            return None
+        return self._method_on_class(
+            located[0], located[1], method, set()
+        )
 
     def resolve(self, caller_key: Tuple[str, str],
                 ref: tuple) -> Optional[Tuple[str, str]]:
@@ -444,9 +518,7 @@ class LockOrderAnalysis:
         kind = ref[0]
         if kind == "self":
             _, cls, m = ref
-            if m in s.classes.get(cls, ()):
-                return (path, f"{cls}.{m}")
-            return None
+            return self._method_on_class(sc, cls, m, set())
         if kind == "name":
             name = ref[1]
             # nested function of the caller?

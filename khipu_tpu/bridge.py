@@ -242,6 +242,55 @@ class BridgeServer:
                 admitted += 1
         return rlp_encode(to_minimal_bytes(admitted))
 
+    def _stream_node_data(self, request: bytes, context) -> bytes:
+        """Cursor-paged, range-filtered node export — the live-
+        rebalance pull path (cluster/rebalance.py). Request
+        ``rlp([cursor, count, [[lo, hi], ...]])`` where each
+        ``[lo, hi)`` is a half-open 64-bit ring-point range the caller
+        is moving; response ``rlp([done, next_cursor, [[hash, value],
+        ...]])`` with at most ``count`` pairs whose key hashes into one
+        of the ranges and sorts after ``cursor``. Iteration is
+        restartable from any cursor (idempotent — exactly what a
+        crash-resumed rebalance replays) and serves durably-landed
+        nodes via the same ``get_node_any`` resolution the GetNodeData
+        cache uses."""
+        from khipu_tpu.cluster.ring import _point
+
+        try:
+            cursor, count_b, raw_ranges = rlp_decode(request)
+            count = min(from_bytes(count_b) or 384, 1024)
+            ranges = [
+                (from_bytes(lo), from_bytes(hi))
+                for lo, hi in raw_ranges
+            ]
+        except Exception as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad: {e}")
+        storages = self.blockchain.storages
+        try:
+            keys = storages.node_keys()
+        except Exception as e:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"node store cannot stream: {e}",
+            )
+        out = []
+        done = b"\x01"
+        for k in keys:
+            if cursor and k <= cursor:
+                continue
+            if ranges:
+                pt = _point(k)
+                if not any(lo <= pt < hi for lo, hi in ranges):
+                    continue
+            if len(out) >= count:
+                done = b""  # more matching keys remain
+                break
+            v = storages.get_node_any(k)
+            if v is not None:
+                out.append([k, v])
+        nxt = out[-1][0] if out else bytes(cursor)
+        return rlp_encode([done, nxt, out])
+
     def _ping(self, request: bytes, context) -> bytes:
         if request == CLOCK_PROBE:
             # shard wall clock, anchored through the tracer epoch so a
@@ -308,6 +357,9 @@ class BridgeServer:
             ),
             "GetNodeData": _guarded("GetNodeData", self._get_node_data),
             "PutNodeData": _guarded("PutNodeData", self._put_node_data),
+            "StreamNodeData": _guarded(
+                "StreamNodeData", self._stream_node_data
+            ),
             "Ping": _guarded("Ping", self._ping),
             "GetTraceSpans": _guarded(
                 "GetTraceSpans", self._get_trace_spans
@@ -421,6 +473,31 @@ class BridgeClient:
             )
             admitted += from_bytes(rlp_decode(out))
         return admitted
+
+    def stream_node_data(self, ranges, cursor: bytes = b"",
+                         count: int = 384):
+        """One page of the shard's nodes whose ring points fall in
+        ``ranges`` (half-open ``[lo, hi)`` 64-bit pairs), resuming
+        after ``cursor``: ``(done, next_cursor, [(hash, value), ...])``.
+        The caller MUST verify each value by content address before
+        forwarding it anywhere (cluster/rebalance.py does)."""
+        payload = rlp_encode([
+            bytes(cursor),
+            to_minimal_bytes(count),
+            [[to_minimal_bytes(lo), to_minimal_bytes(hi)]
+             for lo, hi in ranges],
+        ])
+        done, nxt, pairs = rlp_decode(
+            self._call("StreamNodeData", payload)
+        )
+        # data seam: a `corrupt` rule bit-flips a streamed value — the
+        # rebalancer's receipt-time keccak check MUST catch it
+        return (
+            bool(done),
+            nxt,
+            [(h, fault_value("bridge.node.value", v))
+             for h, v in pairs],
+        )
 
     def ping(self, payload: bytes = b"ping") -> bytes:
         return self._call("Ping", payload)

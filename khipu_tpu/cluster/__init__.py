@@ -18,11 +18,23 @@ from khipu_tpu.cluster.client import (
     ShardMetrics,
 )
 from khipu_tpu.cluster.health import HealthMonitor
+from khipu_tpu.cluster.rebalance import (
+    RebalanceAborted,
+    RebalanceError,
+    Rebalancer,
+    movement_plan,
+)
+from khipu_tpu.cluster.ring import RingSnapshot
 
 __all__ = [
     "HashRing",
+    "RingSnapshot",
     "CircuitBreaker",
     "ShardedNodeClient",
     "ShardMetrics",
     "HealthMonitor",
+    "Rebalancer",
+    "RebalanceError",
+    "RebalanceAborted",
+    "movement_plan",
 ]

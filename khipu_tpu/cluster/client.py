@@ -104,6 +104,7 @@ class ShardMetrics:
         self.failovers = 0  # key-groups handed to the next replica
         self.replicated = 0  # keys write-replicated to this shard
         self.backfilled = 0  # keys re-replicated at re-join (anti-entropy)
+        self.rebalanced = 0  # keys streamed onto this shard (rebalance)
         self.latency_ns = 0  # total RPC wall time
 
     def snapshot(self, breaker: CircuitBreaker, alive: bool) -> dict:
@@ -118,6 +119,7 @@ class ShardMetrics:
             "failovers": self.failovers,
             "replicated": self.replicated,
             "backfilled": self.backfilled,
+            "rebalanced": self.rebalanced,
             "latencySeconds": round(self.latency_ns / 1e9, 6),
             "hitRate": round(
                 self.served / max(1, self.served + self.missing), 4
@@ -165,6 +167,8 @@ class ShardedNodeClient:
         self.backoff_max = backoff_max
         self._clock = clock
         self._sleep = sleep
+        self.breaker_failures = breaker_failures
+        self.breaker_reset = breaker_reset
         # retry-backoff jitter from a per-client seeded stream
         # (ClusterConfig.jitter_seed): chaos replay of a retry schedule
         # is bit-reproducible — module-level random would diverge per
@@ -183,6 +187,7 @@ class ShardedNodeClient:
         self.local_fallbacks = 0  # keys served by the local store
         self.unreachable = 0  # keys no copy could serve
         self._health = None  # attached by HealthMonitor
+        self._rebalancer = None  # attached by Rebalancer
         # keys owed to an endpoint that could not take its replica
         # (dead at placement time, or the batch RPC failed) — drained
         # by ``backfill`` when the endpoint re-joins. Bounded: beyond
@@ -282,10 +287,14 @@ class ShardedNodeClient:
         with span("cluster.fetch", keys=len(remaining)) as fetch_sp:
             # per-request shard selection: group keys by their replica
             # chain so one RPC serves each shard's share of the batch
+            # read_chain = replicas_for outside a transition; mid-
+            # rebalance it tries the NEXT epoch's owners first and
+            # falls back to the committed owners, so a half-streamed
+            # move can never make a key unreadable
             groups: Dict[tuple, List[bytes]] = {}
             for h in remaining:
                 groups.setdefault(
-                    tuple(self.ring.replicas_for(h)), []
+                    tuple(self.ring.read_chain(h)), []
                 ).append(h)
             for chain, keys in groups.items():
                 want = keys
@@ -351,7 +360,10 @@ class ShardedNodeClient:
         per_endpoint: Dict[str, Dict[bytes, bytes]] = {}
         for h, v in nodes.items():
             hb = bytes(h)
-            for endpoint in self.ring.replicas_for(hb):
+            # write_chains = replicas_for outside a transition; mid-
+            # rebalance it is the UNION of both epochs' owners, so
+            # neither cutover nor rollback can lose a live write
+            for endpoint in self.ring.write_chains(hb):
                 per_endpoint.setdefault(endpoint, {})[hb] = bytes(v)
             # an out-of-ring CONFIGURED owner missed this write — it
             # comes back with a stale cache unless backfilled
@@ -432,13 +444,65 @@ class ShardedNodeClient:
     def mark_dead(self, endpoint: str) -> None:
         """Health verdict: take the endpoint out of placement. In-flight
         reads keep their (old-snapshot) replica chains — they fail over
-        normally — new reads stop selecting it."""
+        normally — new reads stop selecting it. An open rebalance
+        transition is aborted FIRST (the staged plan assumed the dead
+        member), so the committed epoch stays authoritative."""
+        rb = self._rebalancer
+        if rb is not None:
+            rb.on_membership_event(endpoint, alive=False)
         self.ring.remove(endpoint)
         self._drop_channel(endpoint)
 
     def mark_alive(self, endpoint: str) -> None:
         if endpoint in self.metrics:
+            rb = self._rebalancer
+            if rb is not None:
+                rb.on_membership_event(endpoint, alive=True)
             self.ring.add(endpoint)
+
+    # ------------------------------------------------------- rebalance
+
+    def attach_rebalancer(self, rebalancer) -> None:
+        """The live-rebalance driver (cluster/rebalance.py) hooks
+        membership verdicts so a shard dying mid-rebalance aborts the
+        transition instead of wedging it."""
+        self._rebalancer = rebalancer
+
+    def admit_endpoint(self, endpoint: str) -> None:
+        """Create the breaker/metrics slots a joining endpoint needs
+        before any RPC can address it. Idempotent; does NOT add the
+        endpoint to any ring — that is the rebalance cutover's job."""
+        if endpoint not in self.breakers:
+            self.breakers[endpoint] = CircuitBreaker(
+                self.breaker_failures, self.breaker_reset, self._clock
+            )
+        if endpoint not in self.metrics:
+            self.metrics[endpoint] = ShardMetrics()
+
+    def forget_endpoint(self, endpoint: str) -> None:
+        """Drop a retired endpoint's channel. Breaker/metrics history
+        stays (counters are cumulative-by-contract); the rings were
+        already updated by the rebalance cutover."""
+        self._drop_channel(endpoint)
+
+    def stream_node_data(self, endpoint: str, ranges, cursor: bytes,
+                         count: int):
+        """One StreamNodeData page from ``endpoint`` through the
+        retry/breaker machinery: ``(done, next_cursor, pairs)``."""
+        return self._call(
+            endpoint,
+            lambda ch: ch.stream_node_data(ranges, cursor, count),
+        )
+
+    def push_nodes(self, endpoint: str, nodes: Mapping[bytes, bytes]) -> int:
+        """Rebalance write path: place a verified batch onto a gaining
+        owner (server re-verifies by content address before admitting,
+        same as the backfill path)."""
+        admitted = self._call(
+            endpoint, lambda ch, b=dict(nodes): ch.put_node_data(b)
+        )
+        self.metrics[endpoint].rebalanced += len(nodes)
+        return admitted
 
     def ping(self, endpoint: str) -> bool:
         """Health probe primitive (bypasses retries: one shot)."""
@@ -455,9 +519,13 @@ class ShardedNodeClient:
     def metrics_snapshot(self) -> dict:
         """Everything khipu_metrics surfaces about the cluster."""
         alive = set(self.ring.members)
+        rb = self._rebalancer
         return {
             "replication": self.ring.replication,
             "members": list(self.ring.members),
+            "epoch": self.ring.epoch,
+            "inTransition": self.ring.in_transition,
+            "rebalance": rb.status() if rb is not None else None,
             "localFallbacks": self.local_fallbacks,
             "unreachable": self.unreachable,
             "missedKeys": self._missed_total,
@@ -484,6 +552,7 @@ class ShardedNodeClient:
              self.missed_dropped),
             ("khipu_cluster_members", "gauge", {},
              len(self.ring.members)),
+            ("khipu_cluster_epoch", "gauge", {}, self.ring.epoch),
         ]
         per_ep = (
             ("khipu_shard_requests_total", "counter", "requests"),
@@ -494,6 +563,7 @@ class ShardedNodeClient:
             ("khipu_shard_failovers_total", "counter", "failovers"),
             ("khipu_shard_replicated_total", "counter", "replicated"),
             ("khipu_shard_backfilled_total", "counter", "backfilled"),
+            ("khipu_shard_rebalanced_total", "counter", "rebalanced"),
         )
         for ep, m in self.metrics.items():
             lb = {"endpoint": ep}

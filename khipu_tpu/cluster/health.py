@@ -76,6 +76,22 @@ class HealthMonitor:
     def alive(self, endpoint: str) -> bool:
         return self._alive.get(endpoint, False)
 
+    def track(self, endpoint: str) -> None:
+        """Start probing an endpoint that joined after construction
+        (live rebalance cutover). Idempotent; the endpoint starts
+        alive — it just proved itself by surviving the stream."""
+        if endpoint not in self._alive:
+            self._alive[endpoint] = True
+            self._misses[endpoint] = 0
+            self._hits[endpoint] = 0
+
+    def untrack(self, endpoint: str) -> None:
+        """Stop probing a retired endpoint (it left the membership on
+        purpose — a dead-verdict for it would be noise)."""
+        self._alive.pop(endpoint, None)
+        self._misses.pop(endpoint, None)
+        self._hits.pop(endpoint, None)
+
     def probe_once(self) -> Dict[str, bool]:
         """One probe round; returns the current verdict map."""
         for ep in list(self._alive):

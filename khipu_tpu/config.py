@@ -199,6 +199,14 @@ class ClusterConfig:
     # schedule must replay bit-identically under the chaos harness
     # (KL003 — no unseeded RNG on cluster paths)
     jitter_seed: int = 0
+    # live rebalance (cluster/rebalance.py): StreamNodeData page size
+    # per pull — bounded so a transfer never monopolizes a shard
+    rebalance_batch: int = 384
+    # admission pressure asserted while a transition epoch is open:
+    # at or above shed_write_at (writes shed first — they double into
+    # both epochs mid-move) but below shed_read_at so user reads keep
+    # flowing through the transfer storm
+    rebalance_pressure: float = 0.88
 
 
 @dataclass(frozen=True)

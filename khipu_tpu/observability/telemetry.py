@@ -72,7 +72,8 @@ __all__ = [
 # watchdog trip kinds — the full label set is exported (zero-valued
 # until tripped) so the khipu_watchdog_trips_total family exists from
 # the first scrape, which is what the bench smoke pin keys on
-WATCHDOG_KINDS = ("stage_stall", "journal_runaway", "scrape_dead")
+WATCHDOG_KINDS = ("stage_stall", "journal_runaway", "scrape_dead",
+                  "rebalance_stuck")
 
 # collector-pipeline stages the watchdog reads from PIPELINE_GAUGES
 # (sync/replay.py: stage_<name>_depth / stage_<name>_busy_s)
@@ -582,7 +583,12 @@ class Watchdog:
       ``journal_runaway_depth``: the committer is wedged while the
       driver keeps sealing;
     * ``scrape_dead`` — a shard the telemetry plane scraped before is
-      now unreachable or stale.
+      now unreachable or stale;
+    * ``rebalance_stuck`` — a ring transition epoch is open while the
+      rebalance progress gauge (keys streamed) stays flat for
+      ``stall_after_s``: movement wedged mid-epoch (attach a source
+      with ``attach_rebalance``; a progressing or closed transition
+      re-arms).
 
     Every trip emits a ``watchdog.<kind>`` instant event into the
     flight recorder (zero-duration span → chrome-trace ``i`` phase) and
@@ -593,7 +599,8 @@ class Watchdog:
                  journal_depth: Optional[Callable[[], int]] = None,
                  telemetry: Optional[ClusterTelemetry] = None,
                  tracer=None, registry: MetricsRegistry = REGISTRY,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 rebalance: Optional[Callable[[], tuple]] = None):
         self.config = config or TelemetryConfig(enabled=True)
         self.registry = registry
         self._pipeline = pipeline  # dict-like stage gauges (or lazy)
@@ -606,6 +613,8 @@ class Watchdog:
         self._stage: Dict[str, dict] = {}
         self._journal_over = False
         self._dead: set = set()
+        self._rebalance_src = rebalance
+        self._reb = {"prog": None, "since": 0.0, "tripped": False}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         registry.register_collector("watchdog", self._registry_samples)
@@ -669,7 +678,38 @@ class Watchdog:
                 self._trip("scrape_dead", endpoint=ep)
                 tripped.append("scrape_dead")
             self._dead = dead
+        if self._rebalance_src is not None:
+            try:
+                open_, prog = self._rebalance_src()
+            except Exception:
+                open_, prog = False, None
+            st = self._reb
+            newly_open = open_ and not st.get("open", False)
+            st["open"] = open_
+            if not open_ or newly_open or prog != st["prog"]:
+                # closed transition, a transition that JUST opened
+                # (the flat-progress clock starts now, not at the
+                # last idle pass), or visible progress: re-arm
+                st["prog"] = prog
+                st["since"] = now
+                st["tripped"] = False
+            elif (not st["tripped"]
+                  and now - st["since"] >= self.config.stall_after_s):
+                st["tripped"] = True
+                self._trip(
+                    "rebalance_stuck", keys_streamed=prog,
+                    stalled_s=round(now - st["since"], 3),
+                )
+                tripped.append("rebalance_stuck")
         return tripped
+
+    def attach_rebalance(
+        self, source: Callable[[], tuple]
+    ) -> None:
+        """Hook a rebalance progress source — ``() -> (transition
+        open, keys streamed)`` (Rebalancer.watch_source). Attachable
+        after construction: the board builds the rebalancer lazily."""
+        self._rebalance_src = source
 
     # ----------------------------------------------------------- thread
 

@@ -63,6 +63,7 @@ class ServiceBoard:
         self._fast_sync = None
         self._cluster = None
         self._cluster_health = None
+        self._rebalancer = None
         self._serving = None
         self._telemetry = None
         self._watchdog = None
@@ -233,6 +234,55 @@ class ServiceBoard:
     def cluster(self):
         return self._cluster
 
+    # -------------------------------------------------- elastic membership
+
+    def _ensure_rebalancer(self):
+        """Lazy rebalance driver (cluster/rebalance.py), wired into the
+        watchdog (``rebalance_stuck``) and the admission plane
+        (``rebalance_pressure``) when those exist."""
+        if self._cluster is None:
+            raise RuntimeError("start_cluster first")
+        if self._rebalancer is None:
+            from khipu_tpu.cluster import Rebalancer
+
+            cc = self.config.cluster
+            self._rebalancer = Rebalancer(
+                self._cluster,
+                batch=cc.rebalance_batch,
+                pressure=cc.rebalance_pressure,
+                log=print,
+            )
+            if self._watchdog is not None:
+                self._watchdog.attach_rebalance(
+                    self._rebalancer.watch_source
+                )
+            if self._serving is not None:
+                from khipu_tpu.serving import rebalance_pressure
+
+                self._serving.admission.add_signal(
+                    rebalance_pressure(self._rebalancer)
+                )
+        return self._rebalancer
+
+    @property
+    def rebalancer(self):
+        return self._rebalancer
+
+    def join_shard(self, endpoint: str) -> int:
+        """Live scale-out: stream the key ranges ``endpoint`` gains in
+        the next ring epoch onto it, then cut the ring over atomically
+        — reads keep flowing (and keep being correct) throughout.
+        Returns the number of keys streamed. Crash-safe: an
+        interrupted join leaves the committed epoch serving;
+        ``board.rebalancer.recover()`` resumes or rolls back."""
+        return self._ensure_rebalancer().join(endpoint)
+
+    def retire_shard(self, endpoint: str) -> int:
+        """Live scale-in: stream the retiring shard's owned ranges to
+        the survivors, cut over, then drop it from the membership and
+        the health prober. Returns the number of keys streamed."""
+        return self._ensure_rebalancer().retire(endpoint)
+
     def start_serving(self, **kwargs):
         """Stand up the serving plane (serving/ package —
         docs/serving.md): the read-your-writes view + SLO-aware
@@ -293,6 +343,10 @@ class ServiceBoard:
                 ),
                 telemetry=self._telemetry,
                 tracer=self.tracer,
+                rebalance=(
+                    self._rebalancer.watch_source
+                    if self._rebalancer is not None else None
+                ),
             )
             self._watchdog.start()
         if self._serving is not None:

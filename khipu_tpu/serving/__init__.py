@@ -31,6 +31,7 @@ from khipu_tpu.serving.admission import (
     cluster_pressure,
     journal_pressure,
     pipeline_pressure,
+    rebalance_pressure,
     txpool_pressure,
 )
 from khipu_tpu.serving.readview import ReadView
@@ -47,6 +48,7 @@ __all__ = [
     "cluster_pressure",
     "journal_pressure",
     "pipeline_pressure",
+    "rebalance_pressure",
     "txpool_pressure",
 ]
 

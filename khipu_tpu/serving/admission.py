@@ -363,6 +363,22 @@ def txpool_pressure(pool) -> Callable[[], float]:
     return signal
 
 
+def rebalance_pressure(rebalancer) -> Callable[[], float]:
+    """Live-rebalance shed signal (cluster/rebalance.py): while a
+    transition epoch is open the rebalancer asserts a fixed pressure
+    (``ClusterConfig.rebalance_pressure``, default 0.88) — above the
+    write shed threshold, so user writes stop doubling into both
+    epochs' replica sets during the transfer storm, but below the read
+    threshold, so cheap reads ride through the move untouched. Exactly
+    zero when idle."""
+
+    def signal() -> float:
+        return rebalancer.pressure()
+
+    signal.signal_name = "rebalance"
+    return signal
+
+
 def cluster_pressure(telemetry) -> Callable[[], float]:
     """Per-shard health folded into admission (the ROADMAP seam:
     "feed admission from per-shard health instead of local signals

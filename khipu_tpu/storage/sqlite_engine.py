@@ -89,6 +89,15 @@ class _SqliteTable:
     def count(self) -> int:
         return self._conn().execute("SELECT COUNT(*) FROM kv").fetchone()[0]
 
+    def keys(self) -> list:
+        """All keys, sorted — the StreamNodeData iteration surface
+        (live rebalance); bytes sort == SQLite BLOB ordering."""
+        return [
+            row[0] for row in self._conn().execute(
+                "SELECT k FROM kv ORDER BY k"
+            )
+        ]
+
     def max_key8(self) -> int:
         row = self._conn().execute(
             "SELECT MAX(k) FROM kv WHERE LENGTH(k) = 8"
@@ -127,6 +136,9 @@ class SqliteKeyValueDataSource(KeyValueDataSource):
     @property
     def count(self) -> int:
         return self._table.count
+
+    def keys(self) -> list:
+        return self._table.keys()
 
     def stop(self) -> None:
         self._table.close()

@@ -36,6 +36,9 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== rebalance smoke (a wedged cutover fails the gate) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --rebalance --smoke
+
 echo "== bench regression gate (baseline: $BASELINE) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-}" python bench.py \
     --compare="$BASELINE" --max-bytes-ratio=1.05 "$@"

@@ -193,6 +193,66 @@ class TestRuleFixtures:
             f.rule == "KL004" and "sleep" in f.message for f in findings
         )
 
+    def test_kl004_inherited_method_resolves_through_mro(self, tmp_path):
+        """ISSUE 11: ``self.m()`` where ``m`` lives on a BASE class
+        still contributes its lock acquisitions to the caller's
+        lockset — a subclass cannot hide a base method's nested lock
+        from the order analysis."""
+        files = {"locks.py": (
+            "import threading\n"
+            "A = threading.Lock()\n"
+            "B = threading.Lock()\n"
+            "class Base:\n"
+            "    def inner(self):\n"
+            "        with B:\n"
+            "            pass\n"
+            "class Sub(Base):\n"
+            "    def outer(self):\n"
+            "        with A:\n"
+            "            self.inner()\n"
+            "def ba():\n"
+            "    with B:\n"
+            "        with A:\n"
+            "            pass\n"
+        )}
+        findings = _scan(tmp_path, files, rules=[RULES_BY_ID["KL004"]])
+        assert any(
+            f.rule == "KL004" and "cycle" in f.message for f in findings
+        )
+        project = load_project([str(tmp_path)])
+        cycles = LockOrderAnalysis(project).cycles()
+        assert len(cycles) == 1 and len(cycles[0]) == 2
+
+    def test_kl004_callable_passed_as_argument_resolves(self, tmp_path):
+        """ISSUE 11: a function REFERENCE handed to another callable
+        under a held lock is a call edge — registry collectors and
+        ``_call(endpoint, op)`` trampolines must not blind the
+        analysis."""
+        files = {"locks.py": (
+            "import threading\n"
+            "A = threading.Lock()\n"
+            "B = threading.Lock()\n"
+            "def takes_b():\n"
+            "    with B:\n"
+            "        pass\n"
+            "def run(fn):\n"
+            "    fn()\n"
+            "def ab():\n"
+            "    with A:\n"
+            "        run(takes_b)\n"
+            "def ba():\n"
+            "    with B:\n"
+            "        with A:\n"
+            "            pass\n"
+        )}
+        findings = _scan(tmp_path, files, rules=[RULES_BY_ID["KL004"]])
+        assert any(
+            f.rule == "KL004" and "cycle" in f.message for f in findings
+        )
+        project = load_project([str(tmp_path)])
+        cycles = LockOrderAnalysis(project).cycles()
+        assert len(cycles) == 1 and len(cycles[0]) == 2
+
     def test_kl004_consistent_order_is_clean(self, tmp_path):
         findings = _scan(tmp_path, {"locks.py": (
             "import threading\n"
