@@ -160,9 +160,28 @@ def _trace_report(stats):
             "device_bytes_total": LEDGER.direction_totals(),
             "ledger_blocks": LEDGER.blocks,
             "transfer_events": LEDGER.recorded,
+            # seal-wall microscope: bytes/block attributed to each
+            # seal sub-phase SITE (seal.upload is the one to watch —
+            # the r05->r06 regression was +252 KB/block right here)
+            "bytes_per_block_by_subphase": (
+                LEDGER.subphase_bytes_per_block()
+            ),
         }
+    # the seal-wall decomposition --trace prints: every seal.* span
+    # plus the in-seal subset whose summed seconds must cover the
+    # monolithic window.seal bar (the acceptance pin)
+    decomp = recorder.seal_decomposition(spans)
     return {
         "phase_seconds": breakdown,
+        "seal_subphases": {
+            k: v["seconds"] for k, v in decomp["all"].items()
+        },
+        "seal_decomposition": {
+            "seal_s": decomp["seal_s"],
+            "subphase_in_seal_s": decomp["subphase_in_seal_s"],
+            "cover": decomp["cover"],
+            "in_seal": decomp["in_seal"],
+        },
         "driver_total_s": round(
             sum(v for k, v in breakdown.items()
                 if k in recorder.DRIVER_PHASES), 4
@@ -992,14 +1011,174 @@ def _compare_line(line, base, bytes_per_block, th):
     return out
 
 
-def bench_compare(path, thresholds=None, runners=None):
+# ---------------------------------------------------- differential diff
+
+
+DEFAULT_DIFF_THRESHOLDS = {
+    # blocks/s below this fraction of the base capture counts as a
+    # regression the attribution must explain
+    "diff_min_blocks_per_s_ratio": 0.9,
+    # a phase's wall seconds must grow past BOTH of these to be named
+    # (wall clocks are noisy; tiny phases double all the time)
+    "diff_phase_rel": 0.20,
+    "diff_phase_abs_s": 0.02,
+    # bytes/block growth past BOTH of these is attributed (and counts
+    # as a regression by itself — measured bytes are not noise)
+    "diff_bytes_rel": 0.10,
+    "diff_bytes_abs": 1024,
+}
+
+
+def _fmt_bytes_per_block(n):
+    if abs(n) >= 1024:
+        return f"{n / 1024:+.1f} KB/block"
+    return f"{n:+d} B/block"
+
+
+def _diff_movement_key(base_m, new_m, key, th, attributions):
+    """Attribute bytes/block growth per phase (or sub-phase site) and
+    direction between two movement blocks. Returns True when anything
+    grew past tolerance."""
+    b = (base_m or {}).get(key) or {}
+    n = (new_m or {}).get(key) or {}
+    grew = False
+    for ph in sorted(set(b) | set(n)):
+        for d in ("h2d", "d2h"):
+            bb = int((b.get(ph) or {}).get(d, 0))
+            nn = int((n.get(ph) or {}).get(d, 0))
+            delta = nn - bb
+            if (delta > th["diff_bytes_abs"]
+                    and delta > th["diff_bytes_rel"] * max(bb, 1)):
+                attributions.append(
+                    f"{ph} {_fmt_bytes_per_block(delta)} ({d}, "
+                    f"{bb} -> {nn})"
+                )
+                grew = True
+    return grew
+
+
+def diff_lines(base, new, thresholds=None):
+    """Attribute the delta between two captures of ONE metric line:
+    blocks/s ratio, per-phase wall seconds, and per-phase /
+    per-sub-phase-site bytes per block. Returns {metric, regressed,
+    attributions: [human-readable strings]} — identical lines diff to
+    no attributions at all (the tolerance contract the analyzer tests
+    pin). This is the line that would have reduced the r05->r06
+    regression hunt to "seal.upload +252 KB/block"."""
+    th = dict(DEFAULT_DIFF_THRESHOLDS)
+    th.update(thresholds or {})
+    metric = new.get("metric") or base.get("metric")
+    out = {"metric": metric, "regressed": False, "attributions": []}
+    bv, nv = base.get("value"), new.get("value")
+    if new.get("unit") == "blocks/s" and bv and nv is not None:
+        ratio = nv / bv
+        out["ratio"] = round(ratio, 3)
+        if ratio < th["diff_min_blocks_per_s_ratio"]:
+            out["regressed"] = True
+            out["attributions"].append(
+                f"blocks/s {bv} -> {nv} ({ratio:.2f}x)"
+            )
+    bp = base.get("phases") or {}
+    np_ = new.get("phases") or {}
+    for ph in sorted(set(bp) | set(np_)):
+        b = bp.get(ph, 0.0)
+        n = np_.get(ph, 0.0)
+        if not isinstance(b, (int, float)):
+            b = 0.0
+        if not isinstance(n, (int, float)):
+            n = 0.0
+        delta = n - b
+        if (delta > th["diff_phase_abs_s"]
+                and delta > th["diff_phase_rel"] * max(b, 1e-9)):
+            out["attributions"].append(
+                f"phase {ph} {delta:+.2f} s ({b:.2f} -> {n:.2f})"
+            )
+    base_m = base.get("movement")
+    new_m = new.get("movement")
+    grew = _diff_movement_key(
+        base_m, new_m, "bytes_per_block_by_phase", th,
+        out["attributions"],
+    )
+    # sub-phase columns (captures from this PR onward): site-level
+    # attribution — "seal.upload grew" instead of "seal grew"
+    grew |= _diff_movement_key(
+        base_m, new_m, "bytes_per_block_by_subphase", th,
+        out["attributions"],
+    )
+    if grew:
+        out["regressed"] = True
+    return out
+
+
+def diff_captures(base_map, new_map, thresholds=None):
+    """Diff two parsed captures (metric -> line, as parse_baseline
+    returns): per-metric attribution over the metrics both carry.
+    Returns {metrics, attributions (flattened, metric-prefixed),
+    regressed, compared, skipped}."""
+    metrics = {}
+    attributions = []
+    regressed = False
+    shared = sorted(set(base_map) & set(new_map))
+    for m in shared:
+        if m == "bench_compare":
+            continue  # a gate line, not a measurement
+        d = diff_lines(base_map[m], new_map[m], thresholds)
+        metrics[m] = d
+        regressed |= d["regressed"]
+        attributions.extend(f"{m}: {a}" for a in d["attributions"])
+    return {
+        "metrics": metrics,
+        "attributions": attributions,
+        "regressed": regressed,
+        "compared": [m for m in shared if m != "bench_compare"],
+        "skipped": sorted(
+            (set(base_map) ^ set(new_map)) - {"bench_compare"}
+        ),
+    }
+
+
+def bench_diff(base_path, new_path, thresholds=None):
+    """``bench.py --diff=BASE.json --diff-to=NEW.json``: offline
+    differential analysis of two captures. Prints the attribution and
+    returns 1 when NEW regresses from BASE (blocks/s past the ratio
+    floor, or measured bytes/block growth past tolerance)."""
+    result = diff_captures(
+        parse_baseline(base_path), parse_baseline(new_path), thresholds
+    )
+    emit(
+        "bench_diff",
+        int(result["regressed"]),
+        "regressed",
+        base=base_path,
+        new=new_path,
+        compared=result["compared"],
+        attributions=result["attributions"],
+    )
+    if result["attributions"]:
+        print(f"bench_diff: {base_path} -> {new_path}", file=sys.stderr)
+        for a in result["attributions"]:
+            print(f"  {a}", file=sys.stderr)
+    else:
+        print(
+            f"bench_diff: no attribution ({base_path} -> {new_path} "
+            "within tolerance)",
+            file=sys.stderr,
+        )
+    return 1 if result["regressed"] else 0
+
+
+def bench_compare(path, thresholds=None, runners=None, diff=False):
     """``bench.py --compare=BASELINE.json``: re-run the headline replay
     configs with the TransferLedger on, diff blocks/s, collect share,
     and device bytes/block against the captured baseline, and return
     non-zero past the thresholds — the bench regression gate
     (scripts/bench_gate.sh wraps this next to tier-1). The emitted
     ``bench_compare`` line carries the movement metrics a FUTURE
-    baseline capture needs for the bytes/block comparison."""
+    baseline capture needs for the bytes/block comparison. With
+    ``diff=True`` (gate passes ``--diff``) each comparison also runs
+    the differential analyzer against the baseline line, so a gate
+    failure prints WHICH phase/site moved, not just that the headline
+    ratio tripped."""
     from khipu_tpu.observability.profiler import LEDGER
 
     th = dict(DEFAULT_COMPARE_THRESHOLDS)
@@ -1031,12 +1210,24 @@ def bench_compare(path, thresholds=None, runners=None):
                     "ledger_blocks": LEDGER.blocks,
                     "bytes_per_block_by_phase":
                         LEDGER.phase_bytes_per_block(),
+                    "bytes_per_block_by_subphase":
+                        LEDGER.subphase_bytes_per_block(),
                 }
             for line in _EMITTED[mark:]:
-                cmp = _compare_line(line, base.get(line["metric"]),
-                                    bpb, th)
+                base_line = base.get(line["metric"])
+                cmp = _compare_line(line, base_line, bpb, th)
                 if movement:
                     cmp["movement"] = movement
+                if diff and base_line is not None:
+                    new_line = dict(line)
+                    if movement:
+                        new_line["movement"] = movement
+                    d = diff_lines(base_line, new_line, thresholds)
+                    if d["attributions"]:
+                        cmp["attribution"] = d["attributions"]
+                        for a in d["attributions"]:
+                            print(f"  diff {line['metric']}: {a}",
+                                  file=sys.stderr)
                 comparisons.append(cmp)
                 failures.extend(cmp["failures"])
     finally:
@@ -1085,6 +1276,8 @@ def bench_capture(out_path, runners=None):
                     "device_bytes_total": LEDGER.direction_totals(),
                     "ledger_blocks": LEDGER.blocks,
                     "bytes_per_block_by_phase": by_phase,
+                    "bytes_per_block_by_subphase":
+                        LEDGER.subphase_bytes_per_block(),
                     "collect_d2h_bytes_per_block": (
                         by_phase.get("collect", {}).get("d2h", 0)
                     ),
@@ -1604,6 +1797,9 @@ def main() -> None:
         bench_rebalance(smoke="--smoke" in sys.argv)
         return
     compare_path = None
+    diff_path = None
+    diff_to_path = None
+    want_diff = False
     thresholds = {}
     for arg in sys.argv[1:]:
         if arg.startswith("--capture="):
@@ -1611,6 +1807,12 @@ def main() -> None:
             return
         if arg.startswith("--compare="):
             compare_path = arg.split("=", 1)[1]
+        elif arg == "--diff":
+            want_diff = True
+        elif arg.startswith("--diff="):
+            diff_path = arg.split("=", 1)[1]
+        elif arg.startswith("--diff-to="):
+            diff_to_path = arg.split("=", 1)[1]
         elif arg.startswith("--min-blocks-ratio="):
             thresholds["min_blocks_per_s_ratio"] = float(
                 arg.split("=", 1)[1]
@@ -1623,8 +1825,18 @@ def main() -> None:
             thresholds["max_bytes_per_block_ratio"] = float(
                 arg.split("=", 1)[1]
             )
+    if diff_path is not None and diff_to_path is not None:
+        # offline differential mode: no replay runs, just attribution
+        sys.exit(bench_diff(diff_path, diff_to_path, thresholds))
+    if diff_path is not None and compare_path is None:
+        print("bench_diff: --diff=BASE.json needs --diff-to=NEW.json",
+              file=sys.stderr)
+        sys.exit(2)
     if compare_path is not None:
-        sys.exit(bench_compare(compare_path, thresholds=thresholds))
+        sys.exit(bench_compare(
+            compare_path, thresholds=thresholds,
+            diff=want_diff or diff_path is not None,
+        ))
     for arg in sys.argv[1:]:
         if arg.startswith("--chaos"):
             seed = int(arg.split("=", 1)[1]) if "=" in arg else 0

@@ -281,6 +281,14 @@ class TelemetryConfig:
     stall_after_s: float = 5.0
     # journal pending() beyond this depth => journal_runaway trip
     journal_runaway_depth: int = 8
+    # phase_anomaly trips (edge-triggered) when a phase's share of
+    # total canonical phase wall time exceeds its ceiling — tuple of
+    # (phase, ceiling) pairs (frozen dataclass: no dict default). The
+    # default watches the seal wall the cost model exists to demolish.
+    phase_share_ceilings: tuple = (("window.seal", 0.6),)
+    # don't judge shares until this much canonical phase time has been
+    # observed (a 0.1 s startup blip trivially exceeds any ceiling)
+    phase_share_min_total_s: float = 5.0
     # gauge families echoed into khipu_cluster_report per shard
     key_gauges: tuple = (
         "khipu_pipeline_in_flight",

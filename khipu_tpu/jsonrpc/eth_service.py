@@ -681,6 +681,20 @@ class EthService:
         n = parse_qty(number) if isinstance(number, str) else int(number)
         return recorder.window_report(n, self.tracer.snapshot())
 
+    def khipu_window_costs(self, number) -> dict:
+        """Roofline verdict for the window containing block ``n``:
+        per-seal-sub-phase attainable vs achieved seconds against the
+        calibrated floors (docs/roofline.md — tunnel rate, dispatch
+        RTT, kernel hash rate), each classified bytes-bound /
+        dispatch-bound / compute-bound / fixed-overhead, plus the
+        headline verdict naming the costliest sub-phase."""
+        from khipu_tpu.observability import costmodel
+
+        n = parse_qty(number) if isinstance(number, str) else int(number)
+        return costmodel.window_costs(
+            n, self.tracer.snapshot(), tracer_=self.tracer
+        )
+
     def khipu_dump_chrome_trace(self, path: str) -> dict:
         """Write the ring's spans as Chrome trace_event JSON (load in
         perfetto / chrome://tracing); returns {path, spans, shards}.

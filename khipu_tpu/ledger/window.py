@@ -304,7 +304,8 @@ class WindowCommitter:
         # at node creation and tries build bottom-up, so a child's
         # index is always below its parent's — by the time a parent is
         # scanned, every child's depth is known
-        with span("window.pack") as pack_sp:
+        _pack_t0 = time.perf_counter() if LEDGER.enabled else 0.0
+        with span("seal.pack") as pack_sp:
             for idx in range(start, end):
                 ph = _make_placeholder(idx)
                 enc = self._staged.get(ph)
@@ -367,6 +368,15 @@ class WindowCommitter:
             pack_sp.set_tag("nodes", len(to_resolve))
             pack_sp.set_tag("depth", max_depth)
             pack_sp.set_tag("ext_refs", len(ext_refs))
+        if LEDGER.enabled:
+            # host-side classification event: how many encoding bytes
+            # the pack step staged for dispatch (the cost model's node
+            # x bytes join for seal.pack)
+            LEDGER.record(
+                "seal.pack", HOST,
+                sum(len(e) for e in to_resolve.values()),
+                duration=time.perf_counter() - _pack_t0,
+            )
 
         job = WindowJob(self, pending, to_resolve, live)
         job.codes, self._window_codes = self._window_codes, []
@@ -463,20 +473,27 @@ class WindowCommitter:
         parts = []
         ext_pos: Dict[bytes, int] = {}
         nxt = 0
-        for src, childs in groups.values():
-            rows = np.asarray(
-                [src.fused_job.dpos[c] for c in childs], dtype=np.int32
-            )
-            parts.append(src.fused_job.digests[rows])
-            for c in childs:
-                ext_pos[c] = nxt
-                nxt += 1
-        if len(parts) == 1:
-            tile = parts[0]
-        else:
-            import jax.numpy as jnp
+        with span("seal.alias_gather", refs=len(ext_refs)):
+            for src, childs in groups.values():
+                rows = np.asarray(
+                    [src.fused_job.dpos[c] for c in childs],
+                    dtype=np.int32,
+                )
+                # d2d gather out of the source job's digest tile: only
+                # the int32 row indices cross the tunnel
+                with LEDGER.transfer(
+                    "seal.alias_gather", H2D, rows.nbytes
+                ):
+                    parts.append(src.fused_job.digests[rows])
+                for c in childs:
+                    ext_pos[c] = nxt
+                    nxt += 1
+            if len(parts) == 1:
+                tile = parts[0]
+            else:
+                import jax.numpy as jnp
 
-            tile = jnp.concatenate(parts, axis=0)
+                tile = jnp.concatenate(parts, axis=0)
         return tile, ext_pos
 
     def collect_roots(self, job: "WindowJob"
@@ -505,15 +522,17 @@ class WindowCommitter:
                     )
         resolved_global = self._resolved_global
         refs = [root_ref for _h, root_ref in job.pending_blocks]
-        if job.mapping is not None:
-            fetched = job.mapping
-        elif job.fused_job is not None:
-            fetched = job.fused_job.fetch_rows(refs)
-        else:
-            fetched = {}
-
         results: List[Tuple[BlockHeader, bytes]] = []
-        with span("window.rootcheck", blocks=len(job.pending_blocks)):
+        # the span covers the per-block digest FETCH as well as the
+        # header comparison — fetch_rows is the d2h that makes this
+        # step cost anything, so excluding it hid the whole sub-phase
+        with span("seal.rootcheck", blocks=len(job.pending_blocks)):
+            if job.mapping is not None:
+                fetched = job.mapping
+            elif job.fused_job is not None:
+                fetched = job.fused_job.fetch_rows(refs)
+            else:
+                fetched = {}
             for header, root_ref in job.pending_blocks:
                 real = fetched.get(root_ref) or resolved_global.get(
                     root_ref
@@ -560,7 +579,7 @@ class WindowCommitter:
 
         live = job.live
         aliases: List[bytes] = []
-        with span("window.admit", live=len(live)):
+        with span("seal.alias_gather", live=len(live)):
             for c, (phs, base) in enumerate(fj.class_rows):
                 enc_dev = fj.encs[c]
                 nb = int(enc_dev.shape[1]) // RATE

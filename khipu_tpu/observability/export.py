@@ -141,6 +141,12 @@ def counter_tracks(spans: Optional[Sequence[Span]] = None,
                     "collector": row["collector"],
                 },
             })
+    if transfers:
+        # roofline verdict per sealed window (attainable vs achieved
+        # over the seal sub-phases) as its own counter track
+        from khipu_tpu.observability.costmodel import cost_tracks
+
+        events.extend(cost_tracks(tracer_=t))
     return events
 
 

@@ -78,6 +78,11 @@ KNOWN_SITES = frozenset({
     "mirror.admit_window", "mirror.get", "mirror.verify",
     # window commit + block persistence (ledger/window.py, sync/replay.py)
     "window.store", "block.save",
+    # seal sub-phase sites (ISSUE 12 seal-wall microscope): one ledger
+    # site per named seal sub-step, same strings as the sub-phase span
+    # names so the cost model can join bytes to seconds without a map
+    "seal.pack", "seal.alias_gather", "seal.dispatch_build",
+    "seal.upload", "seal.rootcheck", "seal.journal",
     # sharded multi-device paths (parallel/)
     "shard.dispatch", "shard.gather", "shard.keccak", "shard.verify",
     # raw keccak ops (ops/)
@@ -89,9 +94,11 @@ KNOWN_SITES = frozenset({
 COLLECT_CLASSES = {
     "fused.collect": "placeholder-resolution",
     "fused.rootcheck": "placeholder-resolution",
+    "seal.rootcheck": "placeholder-resolution",
     "mirror.get": "placeholder-resolution",
     "shard.gather": "placeholder-resolution",
     "mirror.admit_window": "mirror-admit",
+    "seal.alias_gather": "mirror-admit",
     "window.store": "store-write",
     "block.save": "block-save",
 }
@@ -364,13 +371,13 @@ class TransferLedger:
             return None
         window, lo, hi = rng
         phases: Dict[str, dict] = {}
+        subphases: Dict[str, dict] = {}
         directions: Dict[str, int] = {}
         classes: Dict[str, dict] = {}
-        for ev in self.events():
-            if ev.window != window:
-                continue
-            ph = phases.setdefault(
-                ev.phase or "?", {"bytes": 0, "seconds": 0.0, "sites": {}}
+
+        def _acc(bucket: Dict[str, dict], key: str, ev) -> None:
+            ph = bucket.setdefault(
+                key, {"bytes": 0, "seconds": 0.0, "sites": {}}
             )
             site = ph["sites"].setdefault(
                 ev.site,
@@ -382,10 +389,29 @@ class TransferLedger:
             site["count"] += 1
             if ev.direction != HOST:
                 ph["bytes"] += ev.nbytes
+            ph["seconds"] += ev.duration
+
+        for ev in self.events():
+            if ev.window != window:
+                continue
+            phase = ev.phase or "?"
+            # old phase names stay aggregates (back-compat): a dotted
+            # sub-phase ("seal.upload") bills its root ("seal") in
+            # ``phases`` and gets its own full-resolution row in
+            # ``subphases`` — phase x site x bytes x seconds
+            _acc(phases, phase.split(".", 1)[0], ev)
+            if "." in phase:
+                _acc(subphases, phase, ev)
+            # sub-phase SITES always get a row, even when the crossing
+            # ran under a canonical phase tag (the collect-thread
+            # rootcheck keeps phase="collect" so collect-share gauges
+            # stay honest, but its site is seal.rootcheck)
+            elif ev.site.startswith("seal."):
+                _acc(subphases, ev.site, ev)
+            if ev.direction != HOST:
                 directions[ev.direction] = (
                     directions.get(ev.direction, 0) + ev.nbytes
                 )
-            ph["seconds"] += ev.duration
             cls = COLLECT_CLASSES.get(ev.site)
             if cls is not None:
                 agg = classes.setdefault(
@@ -402,6 +428,7 @@ class TransferLedger:
             "block_hi": hi,
             "blocks": n_blocks,
             "phases": phases,
+            "subphases": subphases,
             "device_bytes": directions,
             "device_bytes_per_block": {
                 d: b // n_blocks for d, b in directions.items()
@@ -409,17 +436,47 @@ class TransferLedger:
             "collect_classes": classes,
         }
 
-    def phase_bytes_per_block(self) -> Dict[str, dict]:
+    def phase_bytes_per_block(self, rollup: bool = True) -> Dict[str, dict]:
         """{phase: {h2d: bytes/block, d2h: bytes/block}} over the whole
-        ring — the --trace per-phase breakdown."""
+        ring — the --trace per-phase breakdown. ``rollup=True`` (the
+        default, and what every pre-subphase caller expects) bills a
+        dotted sub-phase ("seal.upload") to its root ("seal");
+        ``rollup=False`` keys by the full dotted phase so --capture can
+        record the sub-phase movement columns."""
         agg: Dict[str, Dict[str, int]] = {}
         for ev in self.events():
             if ev.direction == HOST:
                 continue
-            agg.setdefault(ev.phase or "?", {}).setdefault(
-                ev.direction, 0
-            )
-            agg[ev.phase or "?"][ev.direction] += ev.nbytes
+            ph = ev.phase or "?"
+            if rollup:
+                ph = ph.split(".", 1)[0]
+            agg.setdefault(ph, {}).setdefault(ev.direction, 0)
+            agg[ph][ev.direction] += ev.nbytes
+        blocks = max(1, self.blocks)
+        return {
+            ph: {d: b // blocks for d, b in dirs.items()}
+            for ph, dirs in agg.items()
+        }
+
+    def subphase_bytes_per_block(self) -> Dict[str, dict]:
+        """{subphase: {h2d: .., d2h: ..}} bytes/block for seal.* work,
+        joined by SITE as well as dotted phase tag (the collect-thread
+        seal.rootcheck keeps phase="collect"; its site carries the
+        attribution) — the --capture sub-phase movement columns."""
+        agg: Dict[str, Dict[str, int]] = {}
+        for ev in self.events():
+            if ev.direction == HOST:
+                continue
+            ph = ev.phase or "?"
+            key = None
+            if "." in ph:
+                key = ph
+            elif ev.site.startswith("seal."):
+                key = ev.site
+            if key is None:
+                continue
+            agg.setdefault(key, {}).setdefault(ev.direction, 0)
+            agg[key][ev.direction] += ev.nbytes
         blocks = max(1, self.blocks)
         return {
             ph: {d: b // blocks for d, b in dirs.items()}

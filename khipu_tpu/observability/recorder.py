@@ -41,6 +41,24 @@ PHASE_PERSIST = "window.persist"
 PHASE_SAVE = "window.save"
 PHASE_STALL = "pipeline.stall"
 
+# seal sub-phases (ISSUE 12): the monolithic window.seal span is split
+# into named sub-steps so the seal wall decomposes instead of showing
+# up as one opaque 35 s bar. Sub-phase spans are children of the
+# canonical spans and NEVER count toward phase_breakdown (that would
+# double-bill window.seal); they get their own latency histograms and
+# the cost model joins them with the ledger's same-named sites.
+SEAL_PACK = "seal.pack"
+SEAL_ALIAS_GATHER = "seal.alias_gather"
+SEAL_DISPATCH_BUILD = "seal.dispatch_build"
+SEAL_UPLOAD = "seal.upload"
+SEAL_ROOTCHECK = "seal.rootcheck"
+SEAL_JOURNAL = "seal.journal"
+
+SEAL_SUBPHASES = (
+    SEAL_PACK, SEAL_ALIAS_GATHER, SEAL_DISPATCH_BUILD, SEAL_UPLOAD,
+    SEAL_ROOTCHECK, SEAL_JOURNAL,
+)
+
 LIFECYCLE_PHASES = (
     PHASE_ANNOUNCE, PHASE_IMPORT, PHASE_BUILD, PHASE_SEAL,
     PHASE_DISPATCH, PHASE_COLLECT, PHASE_PERSIST, PHASE_SAVE,
@@ -166,6 +184,57 @@ def phase_breakdown(spans: Sequence[Span]) -> Dict[str, float]:
     return {k: round(v, 6) for k, v in out.items()}
 
 
+def seal_subphase_breakdown(spans: Sequence[Span]) -> Dict[str, dict]:
+    """Wall seconds + span count per seal sub-phase, over every
+    ``seal.*`` span in the snapshot (both the driver-side seal steps
+    and the collect-thread rootcheck/alias-gather)."""
+    out: Dict[str, dict] = {}
+    for s in spans:
+        if s.name in SEAL_SUBPHASES:
+            agg = out.setdefault(s.name, {"seconds": 0.0, "count": 0})
+            agg["seconds"] += s.duration
+            agg["count"] += 1
+    return {
+        k: {"seconds": round(v["seconds"], 6), "count": v["count"]}
+        for k, v in sorted(out.items())
+    }
+
+
+def seal_decomposition(spans: Sequence[Span]) -> dict:
+    """The seal-wall microscope's headline: how much of the monolithic
+    ``window.seal`` wall time the sub-phase spans account for. Only
+    sub-spans whose parent chain reaches window.seal WITHOUT first
+    passing through another canonical phase count as "in seal" — the
+    collect-thread rootcheck (seal.rootcheck under window.collect) is a
+    seal-path step but bills the collector, not the driver's seal bar.
+    """
+    by_id = {s.sid: s for s in spans}
+    # fused.dispatch is NOT a stop: it nests inside window.seal (it is
+    # excluded from phase_breakdown for exactly that reason), so
+    # seal.dispatch_build/seal.upload under it still bill the seal bar
+    canonical = set(DRIVER_PHASES) | set(COLLECTOR_PHASES)
+    seal_s = sum(s.duration for s in spans if s.name == PHASE_SEAL)
+    in_seal: Dict[str, float] = {}
+    for s in spans:
+        if s.name not in SEAL_SUBPHASES:
+            continue
+        p = by_id.get(s.parent) if s.parent is not None else None
+        while p is not None:
+            if p.name in canonical:
+                if p.name == PHASE_SEAL:
+                    in_seal[s.name] = in_seal.get(s.name, 0.0) + s.duration
+                break
+            p = by_id.get(p.parent) if p.parent is not None else None
+    sub_s = sum(in_seal.values())
+    return {
+        "seal_s": round(seal_s, 6),
+        "subphase_in_seal_s": round(sub_s, 6),
+        "cover": round(sub_s / seal_s, 4) if seal_s > 0 else 0.0,
+        "in_seal": {k: round(v, 6) for k, v in sorted(in_seal.items())},
+        "all": seal_subphase_breakdown(spans),
+    }
+
+
 # ----------------------------------------------------------- occupancy
 
 
@@ -264,6 +333,11 @@ def window_report(number: int, spans: Sequence[Span] = ()) -> dict:
         ]
         if window_spans:
             out["phase_wall_seconds"] = phase_breakdown(window_spans)
+            subs = seal_subphase_breakdown(window_spans)
+            if subs:
+                out["subphase_wall_seconds"] = {
+                    k: v["seconds"] for k, v in subs.items()
+                }
     return out
 
 
@@ -379,8 +453,38 @@ try:
             help="wall seconds per canonical lifecycle phase",
             labels={"phase": p},
         )
-        for p in LIFECYCLE_PHASES + (PHASE_STALL,)
+        for p in LIFECYCLE_PHASES + (PHASE_STALL,) + SEAL_SUBPHASES
     }
     _trace.set_phase_observer(PHASE_HISTOGRAMS)
+
+    def phase_shares() -> Dict[str, float]:
+        """{phase: share of total phase wall time} from the cumulative
+        latency histograms. The denominator is canonical phases only
+        (sub-phases nest inside window.seal / window.collect — adding
+        them would double-count the seal wall); sub-phase shares are
+        still reported, as fractions of that same canonical total, so
+        ``seal.upload`` can be read directly against the ceiling."""
+        canon = LIFECYCLE_PHASES + (PHASE_STALL,)
+        sums = {
+            p: PHASE_HISTOGRAMS[p].value["sum"]
+            for p in canon + SEAL_SUBPHASES
+        }
+        total = sum(sums[p] for p in canon)
+        if total <= 0:
+            return {}
+        return {
+            p: round(s / total, 6) for p, s in sums.items() if s > 0
+        }
+
+    def _phase_share_samples():
+        return [
+            ("khipu_phase_share", "gauge", {"phase": p}, v)
+            for p, v in sorted(phase_shares().items())
+        ]
+
+    _REGISTRY.register_collector("phase_share", _phase_share_samples)
 except Exception:  # pragma: no cover - stdlib-only deps
     PHASE_HISTOGRAMS = {}
+
+    def phase_shares() -> Dict[str, float]:
+        return {}

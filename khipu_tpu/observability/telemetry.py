@@ -73,7 +73,7 @@ __all__ = [
 # until tripped) so the khipu_watchdog_trips_total family exists from
 # the first scrape, which is what the bench smoke pin keys on
 WATCHDOG_KINDS = ("stage_stall", "journal_runaway", "scrape_dead",
-                  "rebalance_stuck")
+                  "rebalance_stuck", "phase_anomaly")
 
 # collector-pipeline stages the watchdog reads from PIPELINE_GAUGES
 # (sync/replay.py: stage_<name>_depth / stage_<name>_busy_s)
@@ -588,7 +588,13 @@ class Watchdog:
       rebalance progress gauge (keys streamed) stays flat for
       ``stall_after_s``: movement wedged mid-epoch (attach a source
       with ``attach_rebalance``; a progressing or closed transition
-      re-arms).
+      re-arms);
+    * ``phase_anomaly`` — one lifecycle phase's share of total
+      canonical phase wall time exceeds its configured ceiling (e.g.
+      ``window.seal`` > 0.6 — the seal-wall alarm): the pipeline has
+      collapsed into one phase. Judged only after
+      ``phase_share_min_total_s`` of phase time; re-armed when the
+      share drops back under the ceiling.
 
     Every trip emits a ``watchdog.<kind>`` instant event into the
     flight recorder (zero-duration span → chrome-trace ``i`` phase) and
@@ -615,6 +621,14 @@ class Watchdog:
         self._dead: set = set()
         self._rebalance_src = rebalance
         self._reb = {"prog": None, "since": 0.0, "tripped": False}
+        self._phase_over: Dict[str, bool] = {}
+        self._phase_share_src = None  # injectable: () -> (shares, total_s)
+        # baseline snapshot: shares are judged over phase time accrued
+        # AFTER this watchdog existed, not the process lifetime
+        try:
+            self._phase_base: Dict[str, float] = self._phase_sums()
+        except Exception:
+            self._phase_base = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         registry.register_collector("watchdog", self._registry_samples)
@@ -701,7 +715,79 @@ class Watchdog:
                     stalled_s=round(now - st["since"], 3),
                 )
                 tripped.append("rebalance_stuck")
+        ceilings = getattr(self.config, "phase_share_ceilings", ()) or ()
+        if ceilings:
+            shares, total = self._phase_shares()
+            min_total = getattr(
+                self.config, "phase_share_min_total_s", 5.0
+            )
+            if total >= min_total:
+                for phase, ceiling in ceilings:
+                    share = shares.get(phase, 0.0)
+                    if share > ceiling:
+                        if not self._phase_over.get(phase):
+                            self._phase_over[phase] = True
+                            self._trip(
+                                "phase_anomaly", phase=phase,
+                                share=round(share, 4), ceiling=ceiling,
+                            )
+                            tripped.append("phase_anomaly")
+                    else:
+                        self._phase_over[phase] = False
         return tripped
+
+    def _phase_sums(self) -> dict:
+        """Raw cumulative {phase: wall seconds} from the phase latency
+        histograms (canonical phases + seal sub-phases)."""
+        from khipu_tpu.observability.recorder import (
+            LIFECYCLE_PHASES,
+            PHASE_HISTOGRAMS,
+            PHASE_STALL,
+            SEAL_SUBPHASES,
+        )
+
+        return {
+            p: PHASE_HISTOGRAMS[p].value["sum"]
+            for p in LIFECYCLE_PHASES + (PHASE_STALL,) + SEAL_SUBPHASES
+            if p in PHASE_HISTOGRAMS
+        }
+
+    def _phase_shares(self) -> tuple:
+        """(shares, total canonical seconds) accrued SINCE THIS
+        WATCHDOG was constructed, or an injected source — tests drive
+        anomalies without running a replay.
+
+        The histograms are process-cumulative; judging the process
+        lifetime would let hours of healthy history mask a pipeline
+        that just collapsed (or phase time from before attach trip a
+        freshly started dog). The baseline snapshot taken at
+        construction makes the shares a per-watchdog window."""
+        if self._phase_share_src is not None:
+            return self._phase_share_src()
+        try:
+            from khipu_tpu.observability.recorder import (
+                LIFECYCLE_PHASES,
+                PHASE_STALL,
+            )
+
+            sums = self._phase_sums()
+            base = self._phase_base
+            delta = {
+                p: max(0.0, s - base.get(p, 0.0))
+                for p, s in sums.items()
+            }
+            total = sum(
+                delta.get(p, 0.0)
+                for p in LIFECYCLE_PHASES + (PHASE_STALL,)
+            )
+            if total <= 0:
+                return {}, 0.0
+            return (
+                {p: d / total for p, d in delta.items() if d > 0},
+                total,
+            )
+        except Exception:
+            return {}, 0.0
 
     def attach_rebalance(
         self, source: Callable[[], tuple]

@@ -40,6 +40,7 @@ from typing import Dict, List, Mapping, Optional, Tuple
 import numpy as np
 
 from khipu_tpu.observability.profiler import D2H, H2D, LEDGER
+from khipu_tpu.observability.trace import span as _span
 from khipu_tpu.ops.keccak_jnp import RATE
 
 TILE = 8 * 128  # messages per kernel tile (keccak_pallas.TILE)
@@ -359,7 +360,7 @@ class _ClassMirror:
         window-commit path. No node bytes cross the tunnel; the
         word-major retile happens in the donated jit. ``alias`` keys
         go to the placeholder namespace (see ``alias_rows``)."""
-        with self._lock:
+        with self._lock, _span("mirror.admit_tile", rows=len(keys)):
             tile_idx = self.fill // TILE
             self.resident, self.claimed = self._admit_device(
                 self.resident, self.claimed, tile_idx,

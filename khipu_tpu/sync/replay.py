@@ -776,9 +776,17 @@ class ReplayDriver:
                     # can run (submit enqueues it strictly afterwards).
                     # It is part of sealing — inside the span, so the
                     # driver phase accounting sees the journal cost.
-                    intent_seq = journal.log_intent(
-                        lo, hi, window_parent_root,
-                        [b.header.state_root for b, _ in results_cur],
+                    _j0 = time.perf_counter()
+                    with span("seal.journal", block_lo=lo, block_hi=hi):
+                        intent_seq = journal.log_intent(
+                            lo, hi, window_parent_root,
+                            [b.header.state_root for b, _ in results_cur],
+                        )
+                    # host-side classification event so the window
+                    # report's seal row decomposes WAL cost too
+                    LEDGER.record(
+                        "seal.journal", HOST, 0,
+                        duration=time.perf_counter() - _j0,
                     )
             ph["seal"] += time.perf_counter() - t0
             run_fns = make_stage_jobs(

@@ -42,7 +42,7 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
-from khipu_tpu.observability.profiler import D2H, H2D, LEDGER
+from khipu_tpu.observability.profiler import D2H, H2D, HOST, LEDGER
 from khipu_tpu.observability.recorder import compile_log
 from khipu_tpu.observability.trace import span as _span
 from khipu_tpu.ops.keccak_jnp import RATE
@@ -294,7 +294,7 @@ class FusedJob:
         )
         sub = self.digests[rows]  # d2d gather — no tile crosses
         with _span("fused.rootcheck", rows=len(present)):
-            with LEDGER.transfer("fused.rootcheck", D2H, sub.size):
+            with LEDGER.transfer("seal.rootcheck", D2H, sub.size):
                 d = np.asarray(jax.device_get(sub))
         for i, r in enumerate(present):
             out[r] = d[i].tobytes()
@@ -414,138 +414,147 @@ def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext) -> FusedJob:
 
     from khipu_tpu.ops.keccak_pallas import _pallas_target_count
 
-    phs = list(to_resolve)
+    _build_t0 = time.perf_counter() if LEDGER.enabled else 0.0
+    with _span("seal.dispatch_build", nodes=len(to_resolve)):
+        phs = list(to_resolve)
 
-    # bucket rows by rate-block class; the class set is pinned to a
-    # CANONICAL {1..4} (a state-trie node never exceeds 4 rate blocks:
-    # max branch ~532 B) so every window shares one compiled signature —
-    # windows whose organic class sets differ would otherwise each pay a
-    # fresh multi-second XLA compile. Larger classes appear only for
-    # exotic long-value tries and extend the signature organically.
-    classes: Dict[int, List[bytes]] = {c: [] for c in (1, 2, 3, 4)}
-    for ph in phs:
-        nb = len(to_resolve[ph]) // RATE + 1
-        classes.setdefault(nb, []).append(ph)
-    class_list = sorted(classes)
+        # bucket rows by rate-block class; the class set is pinned to a
+        # CANONICAL {1..4} (a state-trie node never exceeds 4 rate blocks:
+        # max branch ~532 B) so every window shares one compiled signature —
+        # windows whose organic class sets differ would otherwise each pay a
+        # fresh multi-second XLA compile. Larger classes appear only for
+        # exotic long-value tries and extend the signature organically.
+        classes: Dict[int, List[bytes]] = {c: [] for c in (1, 2, 3, 4)}
+        for ph in phs:
+            nb = len(to_resolve[ph]) // RATE + 1
+            classes.setdefault(nb, []).append(ph)
+        class_list = sorted(classes)
 
-    # global digest index = class-major position (class order, row order)
-    dpos: Dict[bytes, int] = {}
-    base = 0
-    nrows_pad: Dict[int, int] = {}
-    for nb in class_list:
-        rows = classes[nb]
-        # +1 guarantees at least one spare padding row for dummy subs;
-        # pallas needs whole 1024-row tiles, the jnp path only pow-2
-        if use_jnp:
-            nrows_pad[nb] = _pow2(len(rows) + 1, floor=16)
+        # global digest index = class-major position (class order, row order)
+        dpos: Dict[bytes, int] = {}
+        base = 0
+        nrows_pad: Dict[int, int] = {}
+        for nb in class_list:
+            rows = classes[nb]
+            # +1 guarantees at least one spare padding row for dummy subs;
+            # pallas needs whole 1024-row tiles, the jnp path only pow-2
+            if use_jnp:
+                nrows_pad[nb] = _pow2(len(rows) + 1, floor=16)
+            else:
+                nrows_pad[nb] = _pallas_target_count(nb, len(rows) + 1)
+            for r, ph in enumerate(rows):
+                dpos[ph] = base + r
+            base += nrows_pad[nb]
+
+        total_rows = base  # ext tiles are indexed past this window's rows
+        ext_pos: Dict[bytes, int] = {}
+        ext_dev = None
+        if ext is not None:
+            ext_dev, ext_pos = ext
+
+        enc_bufs: List[np.ndarray] = []
+        sub_arrays: List[np.ndarray] = []
+        sig: List[Tuple[int, int, int]] = []
+        for nb in class_list:
+            rows = classes[nb]
+            width = nb * RATE
+            npad = nrows_pad[nb]
+            # ONE joined buffer + frombuffer instead of a numpy row-
+            # assignment per node (the row loop was the dominant host cost
+            # of seal); the multi-rate pad bits apply as two vector xors
+            zero = bytes(width)
+            parts: List[bytes] = []
+            lens = np.empty(npad, dtype=np.int64)
+            subs: List[Tuple[int, int, int]] = []  # (row, off, child_gpos)
+            for r, ph in enumerate(rows):
+                enc = to_resolve[ph]
+                parts.append(enc)
+                parts.append(zero[: width - len(enc)])
+                lens[r] = len(enc)
+                pos = enc.find(prefix)
+                while pos >= 0:
+                    child = enc[pos : pos + 32]
+                    cp = dpos.get(child)
+                    if cp is None and ext_pos:
+                        ep = ext_pos.get(child)
+                        if ep is not None:
+                            cp = total_rows + ep  # resolved-input tile row
+                    if cp is not None:
+                        subs.append((r, pos, cp))
+                    pos = enc.find(prefix, pos + 32)
+            # padding rows still need valid keccak padding (their digests
+            # are discarded, but the kernel hashes them)
+            lens[len(rows):] = 0
+            if npad > len(rows):
+                parts.append(zero * (npad - len(rows)))
+            buf = (
+                np.frombuffer(b"".join(parts), dtype=np.uint8)
+                .reshape(npad, width)
+                .copy()
+            )
+            buf[np.arange(npad), lens] ^= 0x01  # multi-rate pad (fixed
+            buf[:, width - 1] ^= 0x80  # region: substitution never touches)
+            # coarse floor: windows of similar size must land in the SAME
+            # compiled signature (every distinct shape costs a fresh XLA
+            # compile on the first window that hits it)
+            nsubs = _pow2(len(subs) + 1, floor=1024 if use_jnp else 4096)
+            dummy_row = nrows_pad[nb] - 1  # guaranteed padding row
+            sub_np = np.full((nsubs, 3), (dummy_row, 0, 0), dtype=np.int32)
+            if subs:
+                sub_np[: len(subs)] = subs
+            enc_bufs.append(buf)
+            sub_arrays.extend(
+                [
+                    np.ascontiguousarray(sub_np[:, 0]),
+                    np.ascontiguousarray(sub_np[:, 1]),
+                    np.ascontiguousarray(sub_np[:, 2]),
+                ]
+            )
+            sig.append((nb, nrows_pad[nb], nsubs))
+
+        # resolved-input tile: always an input (a dummy zero tile when the
+        # window has no cross-refs) so every window shares one compiled
+        # signature family regardless of pipeline depth
+        n_ext = ext_dev.shape[0] if ext_dev is not None else 0
+        ext_rows = _pow2(max(n_ext, 1), floor=EXT_FLOOR)
+        if ext_dev is None:
+            ext_buf = np.zeros((ext_rows, 32), dtype=np.uint8)
+        elif n_ext != ext_rows:
+            import jax.numpy as jnp
+
+            ext_buf = (
+                jnp.zeros((ext_rows, 32), dtype=jnp.uint8)
+                .at[:n_ext]
+                .set(ext_dev)
+            )
         else:
-            nrows_pad[nb] = _pallas_target_count(nb, len(rows) + 1)
-        for r, ph in enumerate(rows):
-            dpos[ph] = base + r
-        base += nrows_pad[nb]
+            ext_buf = ext_dev
 
-    total_rows = base  # ext tiles are indexed past this window's rows
-    ext_pos: Dict[bytes, int] = {}
-    ext_dev = None
-    if ext is not None:
-        ext_dev, ext_pos = ext
+        # coarse: depth 3 and 4 share a compile. Floor 4 (was 8): shallow
+        # windows — the common replay shape — were paying 2x the fixpoint
+        # compute for bucketing alone, and the collector stage that blocks
+        # on this program is the pipeline's critical stage
+        rounds = _pow2(depth, floor=4)
+        run = _build_fused(tuple(sig), rounds, use_jnp, ext_rows)
 
-    enc_bufs: List[np.ndarray] = []
-    sub_arrays: List[np.ndarray] = []
-    sig: List[Tuple[int, int, int]] = []
-    for nb in class_list:
-        rows = classes[nb]
-        width = nb * RATE
-        npad = nrows_pad[nb]
-        # ONE joined buffer + frombuffer instead of a numpy row-
-        # assignment per node (the row loop was the dominant host cost
-        # of seal); the multi-rate pad bits apply as two vector xors
-        zero = bytes(width)
-        parts: List[bytes] = []
-        lens = np.empty(npad, dtype=np.int64)
-        subs: List[Tuple[int, int, int]] = []  # (row, off, child_gpos)
-        for r, ph in enumerate(rows):
-            enc = to_resolve[ph]
-            parts.append(enc)
-            parts.append(zero[: width - len(enc)])
-            lens[r] = len(enc)
-            pos = enc.find(prefix)
-            while pos >= 0:
-                child = enc[pos : pos + 32]
-                cp = dpos.get(child)
-                if cp is None and ext_pos:
-                    ep = ext_pos.get(child)
-                    if ep is not None:
-                        cp = total_rows + ep  # resolved-input tile row
-                if cp is not None:
-                    subs.append((r, pos, cp))
-                pos = enc.find(prefix, pos + 32)
-        # padding rows still need valid keccak padding (their digests
-        # are discarded, but the kernel hashes them)
-        lens[len(rows):] = 0
-        if npad > len(rows):
-            parts.append(zero * (npad - len(rows)))
-        buf = (
-            np.frombuffer(b"".join(parts), dtype=np.uint8)
-            .reshape(npad, width)
-            .copy()
-        )
-        buf[np.arange(npad), lens] ^= 0x01  # multi-rate pad (fixed
-        buf[:, width - 1] ^= 0x80  # region: substitution never touches)
-        # coarse floor: windows of similar size must land in the SAME
-        # compiled signature (every distinct shape costs a fresh XLA
-        # compile on the first window that hits it)
-        nsubs = _pow2(len(subs) + 1, floor=1024 if use_jnp else 4096)
-        dummy_row = nrows_pad[nb] - 1  # guaranteed padding row
-        sub_np = np.full((nsubs, 3), (dummy_row, 0, 0), dtype=np.int32)
-        if subs:
-            sub_np[: len(subs)] = subs
-        enc_bufs.append(buf)
-        sub_arrays.extend(
-            [
-                np.ascontiguousarray(sub_np[:, 0]),
-                np.ascontiguousarray(sub_np[:, 1]),
-                np.ascontiguousarray(sub_np[:, 2]),
-            ]
-        )
-        sig.append((nb, nrows_pad[nb], nsubs))
-
-    # resolved-input tile: always an input (a dummy zero tile when the
-    # window has no cross-refs) so every window shares one compiled
-    # signature family regardless of pipeline depth
-    n_ext = ext_dev.shape[0] if ext_dev is not None else 0
-    ext_rows = _pow2(max(n_ext, 1), floor=EXT_FLOOR)
-    if ext_dev is None:
-        ext_buf = np.zeros((ext_rows, 32), dtype=np.uint8)
-    elif n_ext != ext_rows:
-        import jax.numpy as jnp
-
-        ext_buf = (
-            jnp.zeros((ext_rows, 32), dtype=jnp.uint8)
-            .at[:n_ext]
-            .set(ext_dev)
-        )
-    else:
-        ext_buf = ext_dev
-
-    # coarse: depth 3 and 4 share a compile. Floor 4 (was 8): shallow
-    # windows — the common replay shape — were paying 2x the fixpoint
-    # compute for bucketing alone, and the collector stage that blocks
-    # on this program is the pipeline's critical stage
-    rounds = _pow2(depth, floor=4)
-    run = _build_fused(tuple(sig), rounds, use_jnp, ext_rows)
-
-    # host->device upload = every host-built input buffer (the ext tile
-    # counts only when host-built — gathered device-to-device tiles
-    # never cross the tunnel, which is the whole point of the deep
-    # pipeline). Dispatch is async, so the measured duration is the
-    # enqueue+transfer handoff, not the device compute.
-    up = sum(b.nbytes for b in enc_bufs) + sum(a.nbytes for a in sub_arrays)
-    if isinstance(ext_buf, np.ndarray):
-        up += ext_buf.nbytes
-    with LEDGER.transfer("fused.dispatch", H2D, up):
-        # async: no host sync
-        digests, final_encs = run(*[*enc_bufs, *sub_arrays, ext_buf])
+        # host->device upload = every host-built input buffer (the ext tile
+        # counts only when host-built — gathered device-to-device tiles
+        # never cross the tunnel, which is the whole point of the deep
+        # pipeline). Dispatch is async, so the measured duration is the
+        # enqueue+transfer handoff, not the device compute.
+        up = sum(b.nbytes for b in enc_bufs) + sum(a.nbytes for a in sub_arrays)
+        if isinstance(ext_buf, np.ndarray):
+            up += ext_buf.nbytes
+    if LEDGER.enabled:
+        # host-side classification event: bytes of input buffers the
+        # build step packed, with its wall duration (the cost model's
+        # fixed-overhead join for seal.dispatch_build)
+        LEDGER.record("seal.dispatch_build", HOST, up,
+                      duration=time.perf_counter() - _build_t0)
+    with _span("seal.upload", nbytes=up):
+        with LEDGER.transfer("seal.upload", H2D, up):
+            # async: no host sync
+            digests, final_encs = run(*[*enc_bufs, *sub_arrays, ext_buf])
     try:
         # start the device->host copy NOW: it streams as soon as the
         # fixpoint finishes, so collect()'s device_get returns without

@@ -8,19 +8,20 @@
 # Usage:
 #   scripts/bench_gate.sh [BASELINE.json] [extra bench.py args...]
 #
-# Defaults: BENCH_r06.json (the newest captured baseline — the first
-# one carrying movement numbers, so the bytes/block ratio gate is
-# live) and the thresholds baked into bench.py, EXCEPT the bytes
-# ratio: r06 was captured by the same staged-collector code the gate
-# runs, so device bytes/block should be reproducible within noise —
-# we pin it at 1.05x instead of the legacy 1.25x. Override per-run:
+# Defaults: BENCH_r07.json (the newest captured baseline — the first
+# one carrying per-SUB-PHASE movement columns, so --diff can attribute
+# a regression to e.g. seal.upload) and the thresholds baked into
+# bench.py, EXCEPT the bytes ratio: r07 was captured by the same
+# sub-phase-instrumented code the gate runs, so device bytes/block
+# should be reproducible within noise — we pin it at 1.05x instead of
+# the legacy 1.25x. Override per-run:
 #   scripts/bench_gate.sh BENCH_r06.json --min-blocks-ratio=0.8
 # (a later arg wins: bench.py takes the last value of a repeated flag)
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
-BASELINE="${1:-BENCH_r06.json}"
+BASELINE="${1:-BENCH_r07.json}"
 shift || true
 
 if [ ! -f "$BASELINE" ]; then
@@ -40,7 +41,10 @@ echo "== rebalance smoke (a wedged cutover fails the gate) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --rebalance --smoke
 
 echo "== bench regression gate (baseline: $BASELINE) =="
+# --diff: on a failure (or any movement past tolerance) print the
+# differential attribution — WHICH phase/sub-phase site moved and by
+# how many bytes/block — instead of just the tripped headline ratio
 JAX_PLATFORMS="${JAX_PLATFORMS:-}" python bench.py \
-    --compare="$BASELINE" --max-bytes-ratio=1.05 "$@"
+    --compare="$BASELINE" --diff --max-bytes-ratio=1.05 "$@"
 
 echo "bench_gate: OK"

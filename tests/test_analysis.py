@@ -86,6 +86,38 @@ class TestRuleFixtures:
         assert any("mirror.admitt" in m for m in msgs)
         assert all("KNOWN_SITES" in m for m in msgs)
 
+    def test_kl001_seal_subphase_sites_are_known(self, tmp_path):
+        """The seal sub-phase sites the microscope meters through are
+        registered in KNOWN_SITES — instrumented crossings tagged with
+        them lint clean."""
+        findings = _scan(tmp_path, {"mod.py": (
+            "import jax\n"
+            "def up(x):\n"
+            "    with LEDGER.transfer('seal.upload', 'h2d', 4):\n"
+            "        return jax.device_put(x)\n"
+            "def roots(x):\n"
+            "    with LEDGER.transfer('seal.rootcheck', 'd2h', 4):\n"
+            "        return jax.device_get(x)\n"
+            "def gather(x):\n"
+            "    out = jax.device_get(x)\n"
+            "    LEDGER.record('seal.alias_gather', 'h2d', 4)\n"
+            "    return out\n"
+        )})
+        assert findings == []
+
+    def test_kl001_misspelled_seal_subphase_fires(self, tmp_path):
+        """A typo'd sub-phase site would fork its own series and fall
+        out of the cost model's join — KL001 catches it lexically."""
+        findings = _scan(tmp_path, {"mod.py": (
+            "import jax\n"
+            "def up(x):\n"
+            "    with LEDGER.transfer('seal.uplaod', 'h2d', 4):\n"
+            "        return jax.device_put(x)\n"
+        )})
+        assert _rules_of(findings) == ["KL001"]
+        assert "seal.uplaod" in findings[0].message
+        assert "KNOWN_SITES" in findings[0].message
+
     def test_kl001_dynamic_site_is_out_of_scope(self, tmp_path):
         """A non-literal site expression can't be validated lexically —
         the rule stays quiet rather than guessing."""

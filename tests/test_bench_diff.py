@@ -1,0 +1,221 @@
+"""Differential bench attribution (bench.py --diff).
+
+The analyzer's contract, pinned here with doctored capture pairs:
+
+* identical captures diff to NO attribution at all (the tolerance
+  floor absorbs byte-identical and near-identical reruns);
+* when exactly one sub-phase site regresses (the doctored pair grows
+  ``seal.upload`` by 252 KB/block), the attribution names THAT site
+  and the diff exits non-zero — the line that would have reduced the
+  r05->r06 regression hunt to one grep.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import bench  # noqa: E402
+
+
+def _line():
+    return {
+        "metric": "replay_parallel_commit_fixture_blocks_per_sec",
+        "value": 100.0,
+        "unit": "blocks/s",
+        "phases": {"execute": 2.0, "seal": 1.0, "collect": 0.5,
+                   "_bg": "collector"},
+        "movement": {
+            "device_bytes_total": {"h2d": 4096 * 32, "d2h": 512 * 32},
+            "ledger_blocks": 32,
+            "bytes_per_block_by_phase": {
+                "seal": {"h2d": 4096},
+                "collect": {"d2h": 512},
+            },
+            "bytes_per_block_by_subphase": {
+                "seal.upload": {"h2d": 3072},
+                "seal.alias_gather": {"h2d": 1024},
+                "seal.rootcheck": {"d2h": 256},
+            },
+        },
+    }
+
+
+def _doc(lines):
+    return {
+        "cmd": "test", "rc": 0,
+        "tail": "\n".join(json.dumps(x) for x in lines),
+        "parsed": lines[-1],
+    }
+
+
+def _doctor_upload(line, extra_bytes=258048, slower=True):
+    """Grow seal.upload (and its seal rollup) by ``extra_bytes``/block
+    — 258048 = 252 KB, the shape of a seal-side upload regression."""
+    new = copy.deepcopy(line)
+    if slower:
+        new["value"] = 80.0
+        new["phases"]["seal"] = 1.7
+    new["movement"]["bytes_per_block_by_phase"]["seal"]["h2d"] += (
+        extra_bytes
+    )
+    new["movement"]["bytes_per_block_by_subphase"]["seal.upload"][
+        "h2d"
+    ] += extra_bytes
+    return new
+
+
+class TestDiffLines:
+    def test_identical_lines_produce_no_attribution(self):
+        line = _line()
+        d = bench.diff_lines(line, copy.deepcopy(line))
+        assert d["attributions"] == []
+        assert not d["regressed"]
+        assert d["ratio"] == 1.0
+
+    def test_noise_within_tolerance_is_silent(self):
+        """Small wobble in every series — a honest rerun — attributes
+        nothing: bytes under both the absolute and relative floors,
+        phases under the relative floor, blocks/s above the ratio."""
+        line = _line()
+        new = copy.deepcopy(line)
+        new["value"] = 95.0  # 0.95x > 0.9 floor
+        new["phases"]["seal"] = 1.1  # +10% < 20% rel floor
+        new["movement"]["bytes_per_block_by_subphase"]["seal.upload"][
+            "h2d"
+        ] += 64  # < 1024 abs floor
+        d = bench.diff_lines(line, new)
+        assert d["attributions"] == []
+        assert not d["regressed"]
+
+    def test_single_subphase_regression_is_attributed(self):
+        line = _line()
+        d = bench.diff_lines(line, _doctor_upload(line))
+        assert d["regressed"]
+        joined = "\n".join(d["attributions"])
+        assert "seal.upload +252.0 KB/block" in joined
+        assert "(h2d" in joined
+        # the untouched sites stay out of the attribution
+        assert "alias_gather" not in joined
+        assert "rootcheck" not in joined
+
+    def test_byte_growth_alone_regresses(self):
+        """Measured bytes are deterministic facts, not wall-clock
+        noise: growth past tolerance counts as a regression even when
+        blocks/s holds (the machine may just be less loaded today)."""
+        line = _line()
+        new = _doctor_upload(line, slower=False)
+        d = bench.diff_lines(line, new)
+        assert d["regressed"]
+        assert any("seal.upload" in a for a in d["attributions"])
+        assert not any("blocks/s" in a for a in d["attributions"])
+
+    def test_phase_seconds_attribute_but_do_not_gate(self):
+        """Wall seconds are attribution-only: a phase doubling names
+        itself in the report, but noise-prone clocks never flip the
+        exit code by themselves."""
+        line = _line()
+        new = copy.deepcopy(line)
+        new["phases"]["seal"] = 2.5
+        d = bench.diff_lines(line, new)
+        assert not d["regressed"]
+        assert any(
+            a.startswith("phase seal +1.50 s") for a in d["attributions"]
+        )
+
+    def test_non_numeric_phase_entries_are_ignored(self):
+        line = _line()
+        new = copy.deepcopy(line)
+        new["phases"]["_bg"] = "collector,persister"  # annotation row
+        d = bench.diff_lines(line, new)
+        assert d["attributions"] == []
+
+    def test_missing_movement_in_base_still_diffs(self):
+        """Diffing against a pre-ledger capture (BENCH_r05 shape — no
+        movement block) treats the base as zero and attributes the NEW
+        capture's bytes only past tolerance vs zero."""
+        base = _line()
+        del base["movement"]
+        d = bench.diff_lines(base, _line())
+        # all three sub-phase sites grew from nothing
+        assert any("seal.upload" in a for a in d["attributions"])
+
+
+class TestDiffCaptures:
+    def test_identical_captures_no_attribution(self):
+        base = {"m1": _line()}
+        r = bench.diff_captures(base, copy.deepcopy(base))
+        assert r["attributions"] == []
+        assert not r["regressed"]
+        assert r["compared"] == ["m1"]
+        assert r["skipped"] == []
+
+    def test_regression_names_metric_and_site(self):
+        line = _line()
+        r = bench.diff_captures(
+            {"m1": line}, {"m1": _doctor_upload(line)}
+        )
+        assert r["regressed"]
+        assert any(
+            a.startswith("m1: ") and "seal.upload" in a
+            for a in r["attributions"]
+        )
+
+    def test_disjoint_metrics_are_skipped_not_diffed(self):
+        line = _line()
+        other = dict(_line(), metric="m2")
+        r = bench.diff_captures({"m1": line}, {"m2": other})
+        assert r["compared"] == []
+        assert sorted(r["skipped"]) == ["m1", "m2"]
+        assert not r["regressed"]
+
+    def test_gate_line_is_not_a_measurement(self):
+        line = _line()
+        gate = {"metric": "bench_compare", "value": 0}
+        r = bench.diff_captures(
+            {"m1": line, "bench_compare": gate},
+            {"m1": copy.deepcopy(line), "bench_compare": gate},
+        )
+        assert r["compared"] == ["m1"]
+        assert "bench_compare" not in r["metrics"]
+
+
+class TestDiffCLI:
+    """bench.py --diff=BASE.json --diff-to=NEW.json end to end: the
+    offline mode bench_gate.sh's attribution rides on."""
+
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "bench.py", *args],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+        )
+
+    def test_doctored_pair_attributes_and_exits_nonzero(self, tmp_path):
+        line = _line()
+        base = tmp_path / "base.json"
+        new = tmp_path / "new.json"
+        base.write_text(json.dumps(_doc([line])))
+        new.write_text(json.dumps(_doc([_doctor_upload(line)])))
+        r = self._run(f"--diff={base}", f"--diff-to={new}")
+        assert r.returncode == 1, r.stderr
+        assert "seal.upload +252.0 KB/block" in r.stderr
+
+    def test_identical_pair_exits_zero_with_no_attribution(
+            self, tmp_path):
+        line = _line()
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps(_doc([line])))
+        r = self._run(f"--diff={base}", f"--diff-to={base}")
+        assert r.returncode == 0, r.stderr
+        assert "no attribution" in r.stderr
+
+    def test_diff_without_diff_to_is_a_usage_error(self, tmp_path):
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps(_doc([_line()])))
+        r = self._run(f"--diff={base}")
+        assert r.returncode == 2
+        assert "--diff-to" in r.stderr

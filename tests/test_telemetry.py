@@ -552,6 +552,50 @@ class TestWatchdogUnit:
         ]
         assert evts and evts[0]["ph"] == "i"  # chrome instant event
 
+    def test_phase_anomaly_trips_past_ceiling_and_rearms(self):
+        """window.seal taking >60% of canonical phase wall time (the
+        seal-wall signature) trips phase_anomaly once, stays quiet
+        while it persists, and re-arms when the share recovers."""
+        src = {"shares": {"window.seal": 0.8}, "total": 10.0}
+        dog = _dog({})
+        dog._phase_share_src = lambda: (src["shares"], src["total"])
+        assert dog.check_once(now=0.0) == ["phase_anomaly"]
+        assert dog.check_once(now=1.0) == []  # edge-triggered
+        kind, tags = dog.events[-1]
+        assert kind == "phase_anomaly"
+        assert tags["phase"] == "window.seal"
+        assert tags["share"] == 0.8 and tags["ceiling"] == 0.6
+        src["shares"] = {"window.seal": 0.3}  # recovered: re-arms
+        assert dog.check_once(now=2.0) == []
+        src["shares"] = {"window.seal": 0.9}
+        assert dog.check_once(now=3.0) == ["phase_anomaly"]
+        assert dog.trips["phase_anomaly"] == 2
+
+    def test_phase_anomaly_needs_min_total_seconds(self):
+        """The first milliseconds of a replay are all one phase by
+        construction — shares are not judged before
+        phase_share_min_total_s of canonical phase time exists."""
+        src = {"total": 1.0}
+        dog = _dog({}, phase_share_min_total_s=5.0)
+        dog._phase_share_src = (
+            lambda: ({"window.seal": 0.99}, src["total"])
+        )
+        assert dog.check_once(now=0.0) == []
+        src["total"] = 5.0  # enough signal: judged now
+        assert dog.check_once(now=1.0) == ["phase_anomaly"]
+
+    def test_phase_anomaly_honours_configured_ceilings(self):
+        dog = _dog(
+            {}, phase_share_ceilings=(("window.collect", 0.5),),
+        )
+        dog._phase_share_src = lambda: (
+            {"window.seal": 0.99, "window.collect": 0.3}, 100.0
+        )
+        # seal is way over the DEFAULT ceiling but only collect is
+        # configured — and collect is under its bar
+        assert dog.check_once(now=0.0) == []
+        assert dog.trips["phase_anomaly"] == 0
+
     def test_clean_sweep_120_seeds_zero_trips(self):
         """Synthetic healthy-pipeline traces across 120 seeds: depths
         bounce around but busy_s ALWAYS advances while work is queued
