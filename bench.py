@@ -167,6 +167,16 @@ def _trace_report(stats):
                 LEDGER.subphase_bytes_per_block()
             ),
         }
+        # bulk-tile spill throughput: all persist-phase ledger bytes
+        # (mirror.spill tiles + window.store host writes) over the
+        # persist stage's wall seconds — the number the one-slice-per-
+        # tile spill is supposed to move, pinned in BENCH captures
+        persist_bpb = sum(by_phase.get("persist", {}).values())
+        persist_s = breakdown.get("window.persist", 0.0)
+        movement["persist_bytes_per_sec"] = (
+            round(persist_bpb * LEDGER.blocks / persist_s)
+            if persist_s > 0 else 0
+        )
     # the seal-wall decomposition --trace prints: every seal.* span
     # plus the in-seal subset whose summed seconds must cover the
     # monolithic window.seal bar (the acceptance pin)
@@ -1589,6 +1599,28 @@ def bench_serve(smoke=False):
         assert ts == 1, f"transfer seconds TYPE lines: {ts}"
         assert sh == 1, f"shard health TYPE lines: {sh}"
         assert wd == 1, f"watchdog trips TYPE lines: {wd}"
+        # ISSUE 13 families: the off-driver seal stage gauges, the
+        # adaptive-commit controller, the async-copy fallback counter
+        # and the mirror spill watermark must each expose exactly once
+        # (importing the modules registers them; replay ran above)
+        import khipu_tpu.storage.device_mirror  # noqa: F401
+        import khipu_tpu.sync.adaptive  # noqa: F401
+        import khipu_tpu.trie.fused  # noqa: F401
+
+        text = service.khipu_metrics_text()
+        for fam in (
+            "khipu_pipeline_stage_seal_depth",
+            "khipu_pipeline_stage_seal_busy_s",
+            "khipu_adaptive_device_mode",
+            "khipu_adaptive_flips_total",
+            "khipu_adaptive_depth_hint",
+            "khipu_adaptive_flap_suppressed_total",
+            "khipu_fused_async_copy_fallbacks",
+            "khipu_mirror_spilled_tiles",
+            "khipu_mirror_unspilled_evictions",
+        ):
+            n = text.count(f"# TYPE {fam} gauge")
+            assert n == 1, f"{fam} TYPE lines: {n}"
         assert 'khipu_watchdog_trips_total{kind="journal_runaway"} 1' \
             in text, text
         ctext = service.khipu_cluster_metrics_text()

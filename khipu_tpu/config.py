@@ -138,6 +138,33 @@ class SyncConfig:
     # mirror ring capacity in rows (TILE=1024 multiples per class;
     # total across classes). Sized to hold a few windows' live sets
     mirror_capacity_rows: int = 16384
+    # cost-model-adaptive commit (sync/adaptive.py — docs/roofline.md
+    # "adaptive commit"): an EWMA controller over the per-window
+    # sub-phase verdicts falls device_mirror_commit back to host commit
+    # when the backend makes the fused d2d path slower than the memcpy
+    # it replaced, and sizes pipeline_depth from the seal.upload
+    # bytes-bound/fixed-overhead classification. device_mirror_commit
+    # stays the CAP — adaptive only ever downgrades device -> host
+    adaptive_commit: bool = True
+    # one-shot backend probe at controller construction: time a d2d
+    # gather against the equivalent host memcpy; device mode engages
+    # only when d2d beats memcpy by adaptive_d2d_margin. False skips
+    # the probe (start in the device_mirror_commit mode and let the
+    # EWMA flip if the windows prove it wrong)
+    adaptive_probe: bool = True
+    adaptive_d2d_margin: float = 1.5
+    # EWMA smoothing over per-window per-hash seal cost observations
+    adaptive_ewma_alpha: float = 0.4
+    # Schmitt trigger: flip device -> host when the device EWMA
+    # exceeds flip_ratio x the host estimate; flip back only below
+    # flip_back_ratio x (hysteresis band kills oscillation)
+    adaptive_flip_ratio: float = 2.0
+    adaptive_flip_back_ratio: float = 0.5
+    # windows a new mode must dwell before the controller may flip
+    # again (flap suppression)
+    adaptive_dwell_windows: int = 6
+    # ceiling for the bytes-bound pipeline_depth recommendation
+    adaptive_depth_max: int = 4
     # opcode-level trace for ONE block number (debug-trace-at;
     # VM.scala:40-57) — that block runs sequentially with a per-op line
     debug_trace_at: Optional[int] = None
@@ -283,9 +310,13 @@ class TelemetryConfig:
     journal_runaway_depth: int = 8
     # phase_anomaly trips (edge-triggered) when a phase's share of
     # total canonical phase wall time exceeds its ceiling — tuple of
-    # (phase, ceiling) pairs (frozen dataclass: no dict default). The
-    # default watches the seal wall the cost model exists to demolish.
-    phase_share_ceilings: tuple = (("window.seal", 0.6),)
+    # (phase, ceiling) pairs (frozen dataclass: no dict default). With
+    # the off-driver seal stage the driver's window.seal is a cheap
+    # close-out (anything above 0.3 means pack work leaked back onto
+    # the driver); the heavy pack+upload lives in window.pack, which on
+    # an overlapped pipeline should stay under ~0.85 of phase time.
+    phase_share_ceilings: tuple = (("window.seal", 0.3),
+                                   ("window.pack", 0.85),)
     # don't judge shares until this much canonical phase time has been
     # observed (a 0.1 s startup blip trivially exceeds any ceiling)
     phase_share_min_total_s: float = 5.0

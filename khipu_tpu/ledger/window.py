@@ -115,10 +115,18 @@ class WindowCommitter:
                  get_block_hash=None,
                  fused: bool = False,
                  on_block_committed=None,
-                 mirror=None):
+                 mirror=None,
+                 adaptive=None):
         self.storages = storages
         self.hasher = hasher
         self.fused = fused  # one-dispatch finalize (trie/fused.py)
+        # cost-model-adaptive commit controller (sync/adaptive.py):
+        # consulted per window at PACK time — when it holds host mode
+        # the window skips the fused dispatch and hashes on the host
+        # (the mirror stays attached; content-addressed reads of rows
+        # admitted by earlier device windows stay valid). None = the
+        # configured path is unconditional
+        self.adaptive = adaptive
         # device-resident commit target (storage/device_mirror.py):
         # when set, admit_mirror() lands each sealed window's live
         # nodes in HBM straight from the fused outputs and persist()
@@ -259,23 +267,20 @@ class WindowCommitter:
             self._retired.popleft().fused_job.release()
 
     def seal(self) -> "WindowJob":
-        """Close the current window: pack its placeholder DAG and
-        DISPATCH the fused fixpoint program (async — the device hashes
-        while the caller executes the next window's transactions), or
-        resolve synchronously on the host-hasher path. The session
-        continues: later blocks keep reading the sealed window's staged
-        nodes and committing into the same namespace.
+        """Close the current window ON THE DRIVER THREAD: the cheap DAG
+        close-out only — counter-range capture, pending-block swap, live
+        map, fresh log namespace, staged-code swap. The expensive tail
+        (the pack scan, dispatch build and upload) moved to
+        :meth:`pack_and_dispatch`, which the staged pipeline runs on its
+        seal stage (sync/replay.py) while the driver executes the next
+        window's transactions. The session continues: later blocks keep
+        reading the sealed window's staged nodes and committing into the
+        same namespace.
 
-        Previous windows need NOT be collected first: refs into an
-        in-flight window ride into this dispatch as resolved-input
-        tiles (their final digests gathered device-to-device from the
-        in-flight job's output — docs/window_pipeline.md), so seals can
-        run ``pipeline_depth`` ahead of collects."""
-        # retire windows that left the pipeline since the last seal:
-        # their rows are out of _inflight_rows, so no later seal can
-        # gather from them — drop the digest/encoding device buffers
-        # (HBM stays O(in-flight windows), not O(replayed chain))
-        self.drain_retired()
+        The journal crash contract holds at the new boundary: the
+        driver fsyncs the window's intent AFTER seal() and BEFORE
+        handing the job to the pipeline; pack mutates memory only, so
+        the first durable mutation is still persist()."""
         start, end = self._window_start, self._counter[0]
         self._window_start = end
         pending, self._pending_blocks = self._pending_blocks, []
@@ -288,6 +293,41 @@ class WindowCommitter:
         }
         self._logs = {}
         self.account_trie._logs = self._logs
+        job = WindowJob(self, pending, None, live)
+        job._pack_range = (start, end)
+        job.codes, self._window_codes = self._window_codes, []
+        return job
+
+    def pack_and_dispatch(self, job: "WindowJob") -> None:
+        """Pack the sealed window's placeholder DAG and DISPATCH the
+        fused fixpoint program (async — the device hashes while later
+        windows pack), or resolve synchronously on the host-hasher
+        path. Runs on the pipeline's SEAL STAGE thread — double
+        buffering: window N+1 packs here while window N's upload is in
+        flight on device.
+
+        Previous windows need NOT be collected first: refs into an
+        in-flight window ride into this dispatch as resolved-input
+        tiles (their final digests gathered device-to-device from the
+        in-flight job's output — docs/window_pipeline.md), so seals can
+        run ``pipeline_depth`` ahead of collects.
+
+        Idempotent per job: a chaos death mid-pack re-runs the whole
+        step from ``take_pending`` — every mutation below either
+        repeats to the same value or is guarded, and ``job._packed``
+        flips only at the very end. Single-threaded per committer
+        (one seal stage), which is what keeps the pack of window N+1
+        ordered after N's in-flight row registration."""
+        if job._packed:
+            return
+        # retire windows that left the pipeline: their rows are out of
+        # _inflight_rows, so no later pack can gather from them — drop
+        # the digest/encoding device buffers (HBM stays O(in-flight
+        # windows), not O(replayed chain)). Runs HERE on the single
+        # seal-stage thread — the same thread as _gather_ext, so a
+        # release can never race a gather out of the same array
+        self.drain_retired()
+        start, end = job._pack_range
 
         resolved_global = self._resolved_global
         inflight_rows = self._inflight_rows
@@ -378,9 +418,20 @@ class WindowCommitter:
                 duration=time.perf_counter() - _pack_t0,
             )
 
-        job = WindowJob(self, pending, to_resolve, live)
-        job.codes, self._window_codes = self._window_codes, []
-        if self.fused and to_resolve:
+        job.to_resolve = to_resolve
+        # chaos seam: a die between the pack scan and the dispatch —
+        # the resumed stage re-runs pack_and_dispatch from the top
+        # (memory-only mutations so far; the re-pack is deterministic)
+        from khipu_tpu.chaos import fault_point
+
+        fault_point("collector.pack")
+        adaptive = self.adaptive
+        use_device = bool(
+            self.fused and to_resolve
+            and (adaptive is None or adaptive.device_mode)
+        )
+        _disp_t0 = time.perf_counter()
+        if use_device:
             try:
                 import jax
 
@@ -389,18 +440,42 @@ class WindowCommitter:
                     fused_submit,
                 )
 
-                ext_arg = self._gather_ext(ext_refs) if ext_refs else None
-                job.fused_job = fused_submit(
-                    to_resolve, deps, _PLACEHOLDER_PREFIX,
-                    use_jnp=jax.default_backend() != "tpu",
-                    depth=max_depth,
-                    ext=ext_arg,
-                )
-                if job.fused_job.dpos:
-                    for ph2, row in job.fused_job.dpos.items():
+                if job.fused_job is None:
+                    ext_arg = (
+                        self._gather_ext(ext_refs) if ext_refs else None
+                    )
+                    # tentpole: the mirror's alias-admit gather rides
+                    # INSIDE the dispatch (extra resolved-input rows)
+                    # instead of a separate d2d pass per window
+                    admit_live = (
+                        job.live if self.mirror is not None else None
+                    )
+                    job.fused_job = fused_submit(
+                        to_resolve, deps, _PLACEHOLDER_PREFIX,
+                        use_jnp=jax.default_backend() != "tpu",
+                        depth=max_depth,
+                        ext=ext_arg,
+                        admit_live=admit_live,
+                    )
+                fj = job.fused_job
+                if fj.dpos:
+                    for ph2, row in fj.dpos.items():
                         inflight_rows[ph2] = (job, row)
-                    self._inflight_jobs.append(job)
-                return job
+                    # guard: a death between registration and _packed
+                    # re-runs this block — never double-queue the job
+                    if job not in self._inflight_jobs:
+                        self._inflight_jobs.append(job)
+                if adaptive is not None:
+                    adaptive.observe_window(
+                        "device", len(to_resolve),
+                        time.perf_counter() - _disp_t0,
+                    )
+                    if fj.upload_nbytes:
+                        adaptive.note_upload(
+                            fj.upload_nbytes, fj.upload_seconds
+                        )
+                job._packed = True
+                return
             except FusedUnsupported:
                 pass
             except Exception as e:
@@ -432,6 +507,13 @@ class WindowCommitter:
         # its digests are already in _resolved_global at the next seal)
         from khipu_tpu.trie.fused import topo_levels
 
+        # when the ADAPTIVE controller forced host mode, hash with the
+        # scalar host hasher even if the committer was built with the
+        # device bulk hasher — the whole point of the downgrade is to
+        # stop paying O(levels) device dispatches per window
+        hasher = self.hasher
+        if adaptive is not None and not adaptive.device_mode:
+            hasher = host_hasher
         mapping: Dict[bytes, bytes] = {}
         for child, (src, _row) in ext_refs.items():
             real = src.fused_job.collect().get(child)
@@ -448,14 +530,19 @@ class WindowCommitter:
                     _substitute_bytes(to_resolve[ph], mapping)
                     for ph in level
                 ]
-                digests = self.hasher(encodings)
+                digests = hasher(encodings)
                 mapping.update(zip(level, digests))
         job.mapping = mapping
         # digests are FINAL here — publish now so the next seal resolves
         # this window's refs without a barrier (persistence is still
-        # gated by collect's root checks)
+        # gated by collect's root checks); idempotent on a re-run
         resolved_global.update(mapping)
-        return job
+        if adaptive is not None:
+            adaptive.observe_window(
+                "host", len(to_resolve),
+                time.perf_counter() - _disp_t0,
+            )
+        job._packed = True
 
     def _gather_ext(self, ext_refs) -> Tuple[object, Dict[bytes, int]]:
         """Build the resolved-input tile for ``fused_submit``: gather
@@ -509,6 +596,10 @@ class WindowCommitter:
         resolves through that job's own fetch_rows via
         ``_inflight_rows`` — rows are deregistered only at the end of
         persist, so FIFO stage order guarantees the source is there."""
+        # non-staged callers (finalize, degraded collector, direct
+        # tests) reach here straight from seal() — pack lazily
+        if not job._packed:
+            self.pack_and_dispatch(job)
         if job.fused_job is not None and job in self._inflight_jobs:
             for other in self._inflight_jobs:
                 if other is job:
@@ -567,9 +658,31 @@ class WindowCommitter:
         No-op without a mirror or on the host-hasher path."""
         fj = job.fused_job
         mirror = self.mirror
-        if mirror is None or fj is None or fj.encs is None:
+        if mirror is None or fj is None:
             if fj is not None:
                 fj.release_encs()
+            return
+        # fast path: the dispatch itself already gathered the live
+        # rows (trie/fused.py admit_live) — the tiles land straight in
+        # the mirror with zero extra device round-trips. The span
+        # keeps the seal.alias_gather name so bench --diff attributes
+        # the eliminated gather to the same site
+        tiles = fj.admit_tiles
+        if tiles is not None:
+            aliases2: List[bytes] = []
+            with span("seal.alias_gather", live=len(job.live),
+                      fused_admit=True):
+                for nb2, keys2, enc_g2, claim_g2, lengths2 in tiles:
+                    mirror.admit_device(
+                        nb2, keys2, enc_g2, claim_g2, lengths2
+                    )
+                    aliases2.extend(k for k in keys2 if k is not None)
+            job.aliases = aliases2
+            fj.admit_tiles = None  # free the gathered device arrays
+            fj.release_encs()
+            return
+        if fj.encs is None:
+            fj.release_encs()
             return
         import numpy as np
         import jax.numpy as jnp
@@ -679,8 +792,33 @@ class WindowCommitter:
 
         from khipu_tpu.chaos import fault_point
 
+        # bulk-tile spill: the mirror's resident rows ARE the final
+        # substituted encodings — read them back one whole-tile array
+        # slice per mirror tile (mirror.spill) instead of substituting
+        # every node on the host. Rows ring-evicted before the spill
+        # fall back to host substitution below (and count in
+        # khipu_mirror_unspilled_evictions)
+        spilled: Dict[bytes, bytes] = {}
+        if published and self.mirror is not None and reals:
+            spilled = self.mirror.spill_rows(reals)
+
         with span("window.store", live=len(live_phs)):
-            subbed = _substitute_many(encs, _lookup)
+            if spilled:
+                miss = [
+                    i for i, real in enumerate(reals)
+                    if real not in spilled
+                ]
+                miss_sub = (
+                    _substitute_many([encs[i] for i in miss], _lookup)
+                    if miss else []
+                )
+                miss_map = dict(zip(miss, miss_sub))
+                subbed = [
+                    miss_map[i] if i in miss_map else spilled[real]
+                    for i, real in enumerate(reals)
+                ]
+            else:
+                subbed = _substitute_many(encs, _lookup)
             account_nodes: Dict[bytes, bytes] = {}
             storage_nodes: Dict[bytes, bytes] = {}
             storage_phs = self._storage_phs
@@ -765,11 +903,12 @@ class WindowJob:
 
     __slots__ = ("committer", "pending_blocks", "to_resolve", "live",
                  "fused_job", "mapping", "codes", "results", "aliases",
-                 "_roots_checked")
+                 "_roots_checked", "_packed", "_pack_range")
 
     def __init__(self, committer, pending_blocks, to_resolve, live):
         self.committer = committer
         self.pending_blocks = pending_blocks
+        # None until pack_and_dispatch runs (seal() is close-out only)
         self.to_resolve = to_resolve
         self.live = live
         self.fused_job = None
@@ -779,3 +918,7 @@ class WindowJob:
         self.results: Optional[List[Tuple[BlockHeader, bytes]]] = None
         self.aliases: List[bytes] = []
         self._roots_checked = False
+        # pack_and_dispatch state: the counter range captured at seal
+        # and the flipped-at-the-end idempotency latch
+        self._packed = False
+        self._pack_range: Tuple[int, int] = (0, 0)

@@ -76,6 +76,11 @@ KNOWN_SITES = frozenset({
     # device-resident node mirror (storage/device_mirror.py)
     "mirror.init", "mirror.claim", "mirror.admit",
     "mirror.admit_window", "mirror.get", "mirror.verify",
+    # bulk-tile persist spill: one D2H array-slice read per mirror tile
+    "mirror.spill",
+    # adaptive-commit backend probe (sync/adaptive.py): one-shot d2d vs
+    # memcpy calibration upload, charged once per process per backend
+    "adaptive.probe",
     # window commit + block persistence (ledger/window.py, sync/replay.py)
     "window.store", "block.save",
     # seal sub-phase sites (ISSUE 12 seal-wall microscope): one ledger
@@ -99,6 +104,7 @@ COLLECT_CLASSES = {
     "shard.gather": "placeholder-resolution",
     "mirror.admit_window": "mirror-admit",
     "seal.alias_gather": "mirror-admit",
+    "mirror.spill": "store-write",
     "window.store": "store-write",
     "block.save": "block-save",
 }

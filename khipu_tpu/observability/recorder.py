@@ -35,6 +35,10 @@ PHASE_ANNOUNCE = "announce"
 PHASE_IMPORT = "import"
 PHASE_BUILD = "window.build"
 PHASE_SEAL = "window.seal"
+# the off-driver seal stage (ISSUE 13): pack + dispatch build + upload
+# run on the collector's front stage thread under this phase; the
+# driver's window.seal is just the cheap DAG close-out + journal fsync
+PHASE_PACK = "window.pack"
 PHASE_DISPATCH = "fused.dispatch"
 PHASE_COLLECT = "window.collect"
 PHASE_PERSIST = "window.persist"
@@ -61,7 +65,7 @@ SEAL_SUBPHASES = (
 
 LIFECYCLE_PHASES = (
     PHASE_ANNOUNCE, PHASE_IMPORT, PHASE_BUILD, PHASE_SEAL,
-    PHASE_DISPATCH, PHASE_COLLECT, PHASE_PERSIST, PHASE_SAVE,
+    PHASE_PACK, PHASE_DISPATCH, PHASE_COLLECT, PHASE_PERSIST, PHASE_SAVE,
 )
 # phases a windowed-replay block must traverse for its record to be
 # "complete" (announce/import appear only on the live-sync path;
@@ -71,9 +75,9 @@ REQUIRED_PHASES = (PHASE_BUILD, PHASE_SEAL, PHASE_COLLECT, PHASE_PERSIST,
 
 DRIVER_PHASES = (PHASE_ANNOUNCE, PHASE_IMPORT, PHASE_BUILD, PHASE_SEAL,
                  PHASE_STALL)
-# the three collector stage threads (sync/replay.py staged pipeline):
-# rootcheck+mirror-admit, host spill, block save
-COLLECTOR_PHASES = (PHASE_COLLECT, PHASE_PERSIST, PHASE_SAVE)
+# the four collector stage threads (sync/replay.py staged pipeline):
+# pack+dispatch+upload, rootcheck+mirror-admit, host spill, block save
+COLLECTOR_PHASES = (PHASE_PACK, PHASE_COLLECT, PHASE_PERSIST, PHASE_SAVE)
 
 
 def spans_for_block(spans: Iterable[Span], number: int) -> List[Span]:
@@ -201,19 +205,22 @@ def seal_subphase_breakdown(spans: Sequence[Span]) -> Dict[str, dict]:
 
 
 def seal_decomposition(spans: Sequence[Span]) -> dict:
-    """The seal-wall microscope's headline: how much of the monolithic
-    ``window.seal`` wall time the sub-phase spans account for. Only
-    sub-spans whose parent chain reaches window.seal WITHOUT first
-    passing through another canonical phase count as "in seal" — the
-    collect-thread rootcheck (seal.rootcheck under window.collect) is a
-    seal-path step but bills the collector, not the driver's seal bar.
+    """The seal-wall microscope's headline: how much of the seal-path
+    wall time (driver ``window.seal`` close-out + off-driver
+    ``window.pack`` stage) the sub-phase spans account for. Only
+    sub-spans whose parent chain reaches window.seal or window.pack
+    WITHOUT first passing through another canonical phase count as "in
+    seal" — the collect-thread rootcheck (seal.rootcheck under
+    window.collect) is a seal-path step but bills the collector's
+    collect stage, not the seal bar.
     """
     by_id = {s.sid: s for s in spans}
-    # fused.dispatch is NOT a stop: it nests inside window.seal (it is
+    # fused.dispatch is NOT a stop: it nests inside window.pack (it is
     # excluded from phase_breakdown for exactly that reason), so
     # seal.dispatch_build/seal.upload under it still bill the seal bar
     canonical = set(DRIVER_PHASES) | set(COLLECTOR_PHASES)
-    seal_s = sum(s.duration for s in spans if s.name == PHASE_SEAL)
+    seal_like = (PHASE_SEAL, PHASE_PACK)
+    seal_s = sum(s.duration for s in spans if s.name in seal_like)
     in_seal: Dict[str, float] = {}
     for s in spans:
         if s.name not in SEAL_SUBPHASES:
@@ -221,7 +228,7 @@ def seal_decomposition(spans: Sequence[Span]) -> dict:
         p = by_id.get(s.parent) if s.parent is not None else None
         while p is not None:
             if p.name in canonical:
-                if p.name == PHASE_SEAL:
+                if p.name in seal_like:
                     in_seal[s.name] = in_seal.get(s.name, 0.0) + s.duration
                 break
             p = by_id.get(p.parent) if p.parent is not None else None

@@ -77,7 +77,7 @@ WATCHDOG_KINDS = ("stage_stall", "journal_runaway", "scrape_dead",
 
 # collector-pipeline stages the watchdog reads from PIPELINE_GAUGES
 # (sync/replay.py: stage_<name>_depth / stage_<name>_busy_s)
-_STAGES = ("collect", "persist", "save")
+_STAGES = ("seal", "collect", "persist", "save")
 
 # HealthScore component weights (must sum to 1.0)
 _W_FRESH, _W_BREAKER, _W_ERRORS, _W_LATENCY = 0.4, 0.3, 0.2, 0.1

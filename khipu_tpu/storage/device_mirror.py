@@ -40,10 +40,23 @@ from typing import Dict, List, Mapping, Optional, Tuple
 import numpy as np
 
 from khipu_tpu.observability.profiler import D2H, H2D, LEDGER
+from khipu_tpu.observability.registry import REGISTRY
 from khipu_tpu.observability.trace import span as _span
 from khipu_tpu.ops.keccak_jnp import RATE
 
 TILE = 8 * 128  # messages per kernel tile (keccak_pallas.TILE)
+
+MIRROR_GAUGES = REGISTRY.gauge_group("khipu_mirror", {
+    # ring evictions that overwrote a window row BEFORE the persist
+    # stage spilled it to the host store (the row stays readable
+    # through the session's staged encodings, but the bulk-tile spill
+    # must fall back to host substitution for it — a sizing signal:
+    # nonzero means mirror_capacity_rows is too small for the
+    # configured pipeline depth)
+    "unspilled_evictions": 0,
+    # whole resident tiles fetched by the bulk spill read-back
+    "spilled_tiles": 0,
+}, help="device-mirror spill watermark state (storage/device_mirror.py)")
 
 
 def _pack_word_major(padded_rows: np.ndarray) -> np.ndarray:
@@ -241,6 +254,12 @@ class _ClassMirror:
         self.alias_rows: Dict[bytes, int] = {}
         self.row_hash: List[Optional[bytes]] = [None] * capacity_rows
         self.lengths: Dict[bytes, int] = {}  # exact unpadded length
+        # the SPILL WATERMARK: keys admitted from a window commit that
+        # the persist stage has not yet written to the host store.
+        # Ring eviction consults this set — overwriting an unspilled
+        # row is counted (khipu_mirror_unspilled_evictions) because it
+        # forces the spill back onto the host-substitution path
+        self.unspilled: set = set()
         self._lock = threading.RLock()
         (self._run, self._set_tile, self._admit_device,
          self._verify) = _class_kernels(nblocks, exact_len, interpret)
@@ -335,6 +354,14 @@ class _ClassMirror:
             del self.alias_rows[old]
             self.lengths.pop(old, None)
             self.count -= 1
+        else:
+            return
+        # spill-watermark check: overwriting a row the persist stage
+        # has not spilled yet is legal (the session's staged encodings
+        # still serve it) but costs the bulk spill its fast path
+        if old in self.unspilled:
+            self.unspilled.discard(old)
+            MIRROR_GAUGES["unspilled_evictions"] += 1
 
     def _bookkeep_tile(self, keys, lengths,
                        target: Dict[bytes, int]) -> None:
@@ -369,6 +396,11 @@ class _ClassMirror:
             self._bookkeep_tile(
                 keys, lengths, self.alias_rows if alias else self.rows
             )
+            if alias:
+                # below the spill watermark until persist reads them
+                self.unspilled.update(
+                    k for k in keys if k is not None
+                )
 
     def rekey(self, mapping: Mapping[bytes, bytes]) -> int:
         """Move alias-keyed rows to their real content addresses once
@@ -389,6 +421,9 @@ class _ClassMirror:
                 ln = self.lengths.pop(alias, None)
                 if ln is not None:
                     self.lengths[real] = ln
+                if alias in self.unspilled:
+                    self.unspilled.discard(alias)
+                    self.unspilled.add(real)
                 moved += 1
         return moved
 
@@ -401,6 +436,7 @@ class _ClassMirror:
                     self.row_hash[row] = None
                     self.count -= 1
                 self.lengths.pop(alias, None)
+                self.unspilled.discard(alias)
 
     def fetch_row(self, key: bytes) -> Optional[bytes]:
         """Read one row back by content address (unpadded). Lock held
@@ -423,6 +459,52 @@ class _ClassMirror:
                     jax.device_get(self.resident[t, :, i, j])
                 ).astype("<u4")
             return words.tobytes()[:ln]
+
+    def spill_rows(self, keys) -> Dict[bytes, bytes]:
+        """Bulk read-back for the persist spill: ONE whole-tile array
+        slice per resident tile covering the requested keys, instead
+        of a device round-trip per node (``fetch_row``). Rows come
+        back FINAL (the admitted encodings already carry real child
+        digests), unpadded via the stored lengths. Keys not resident
+        (ring-evicted before the spill) are simply absent — the
+        caller substitutes those on the host. Fetched keys drop below
+        the spill watermark."""
+        import jax
+
+        out: Dict[bytes, bytes] = {}
+        with self._lock:
+            by_tile: Dict[int, List[Tuple[bytes, int, int]]] = {}
+            for key in keys:
+                row = self.rows.get(key)
+                if row is None:
+                    row = self.alias_rows.get(key)
+                if row is None:
+                    continue
+                ln = self.lengths.get(key)
+                if not ln:
+                    continue
+                by_tile.setdefault(row // TILE, []).append(
+                    (key, row % TILE, ln)
+                )
+            for t in sorted(by_tile):
+                with LEDGER.transfer(
+                    "mirror.spill", D2H, self.nwords * 4 * TILE
+                ):
+                    planes = np.asarray(
+                        # khipu-lint: ok KL004 fetch must finish under the install lock
+                        jax.device_get(self.resident[t])
+                    )  # u32[nwords, 8, 128]
+                MIRROR_GAUGES["spilled_tiles"] += 1
+                # word-major -> row-major: row r of the tile lives at
+                # [:, r // 128, r % 128] (same mapping as fetch_row)
+                rows_u8 = np.ascontiguousarray(
+                    planes.transpose(1, 2, 0).reshape(TILE, self.nwords)
+                    .astype("<u4")
+                ).view(np.uint8).reshape(TILE, self.width)
+                for key, r, ln in by_tile[t]:
+                    out[key] = rows_u8[r, :ln].tobytes()
+                    self.unspilled.discard(key)
+        return out
 
     def verify(self) -> int:
         import jax
@@ -567,6 +649,29 @@ class DeviceNodeMirror:
         for cm in list(self._classes.values()):
             if cm.alias_rows:
                 cm.drop_aliases(aliases)
+
+    def spill_rows(self, keys) -> Dict[bytes, bytes]:
+        """Bulk-tile read-back of resident rows for the persist spill:
+        one array-slice fetch per covered mirror tile per class (site
+        ``mirror.spill``). Missing keys (evicted, never admitted) are
+        absent from the result — the caller's host path covers them."""
+        out: Dict[bytes, bytes] = {}
+        remaining = list(keys)
+        with _span("mirror.spill", rows=len(remaining)):
+            for cm in list(self._classes.values()):
+                if not remaining:
+                    break
+                got = cm.spill_rows(remaining)
+                if got:
+                    out.update(got)
+                    remaining = [k for k in remaining if k not in out]
+        return out
+
+    @property
+    def unspilled_count(self) -> int:
+        return sum(
+            len(cm.unspilled) for cm in list(self._classes.values())
+        )
 
     # ------------------------------------------------------------ reads
 

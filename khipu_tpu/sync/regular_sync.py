@@ -401,6 +401,11 @@ class RegularSyncService:
             # take falls through to the healing per-block path below
             window = self.config.sync.commit_window_blocks
             if window > 1 and not is_reorg and len(blocks) >= window:
+                # the adaptive backend probe it can reach is one-shot,
+                # process-cached (~ms), and must finish before any
+                # window commits anyway — holding _import_lock across
+                # it cannot deadlock (the probe takes no locks)
+                # khipu-lint: ok KL004 one-shot cached probe, no lock taken inside
                 done = self._import_windowed(blocks)
                 if done:
                     if self.txpool is not None:

@@ -58,10 +58,12 @@ PIPELINE_GAUGES = REGISTRY.gauge_group("khipu_pipeline", {
     "sync_fallback_windows": 0,  # windows committed synchronously after
     # a collector death (graceful degradation — docs/recovery.md)
     # per-stage occupancy/depth of the staged collector pipeline
-    # (collect -> persist -> save; docs/window_pipeline.md)
+    # (seal -> collect -> persist -> save; docs/window_pipeline.md)
+    "stage_seal_depth": 0,
     "stage_collect_depth": 0,
     "stage_persist_depth": 0,
     "stage_save_depth": 0,
+    "stage_seal_busy_s": 0.0,
     "stage_collect_busy_s": 0.0,
     "stage_persist_busy_s": 0.0,
     "stage_save_busy_s": 0.0,
@@ -87,11 +89,13 @@ class ReplayStats:
     # per-phase wall-clock split (seconds): senders / validate / execute
     # / commit / seal / collect / save — the breakdown that names the
     # next bottleneck instead of guessing it. Under the deep pipeline
+    # `seal` is the driver's cheap close-out + journal fsync and
     # `collect`/`save` are DRIVER-THREAD STALL (backpressure + drains);
-    # the staged collector's busy time lands in `collect_bg` (root
-    # checks + mirror admit) / `persist_bg` (async host spill) /
-    # `save_bg` (block saves) — those overlap execute, so adding them
-    # to wall clock would double-count
+    # the staged collector's busy time lands in `seal_bg` (pack +
+    # dispatch build + upload) / `collect_bg` (root checks + mirror
+    # admit) / `persist_bg` (async host spill) / `save_bg` (block
+    # saves) — those overlap execute, so adding them to wall clock
+    # would double-count
     phases: dict = field(default_factory=dict)
     # fraction of the collector's busy time that overlapped driver work
     # (1.0 = collect/save fully hidden behind execution)
@@ -107,10 +111,13 @@ class ReplayStats:
 
 class _WindowCollector:
     """Staged background collector pipeline: each window job flows
-    through up to three bounded FIFO stages on dedicated threads —
-    **collect** (root checks + d2d mirror admit), **persist** (async
-    host spill of the window's nodes), **save** (block storage) — while
-    the driver executes the next window's transactions. ``submit``
+    through up to four bounded FIFO stages on dedicated threads —
+    **seal** (the pack scan + fused dispatch build + upload, off the
+    driver; window N+1 packs while window N's upload is in flight —
+    the double buffering), **collect** (root checks + d2d mirror
+    admit), **persist** (async host spill of the window's nodes),
+    **save** (block storage) — while the driver executes the next
+    window's transactions. ``submit``
     enqueues one job (a single callable, or a tuple of per-stage
     callables) and blocks only while ``depth`` jobs already occupy the
     first stage (backpressure); stage hand-offs are bounded the same
@@ -126,7 +133,7 @@ class _WindowCollector:
     re-raises on the driver thread at its next submit/drain, so a
     mismatch still names the failing block number."""
 
-    STAGES = ("collect", "persist", "save")
+    STAGES = ("seal", "collect", "persist", "save")
 
     def __init__(self, depth: int, join_timeout: float = 60.0,
                  liveness_poll: float = 0.1):
@@ -509,8 +516,8 @@ class ReplayDriver:
         stats = ReplayStats()
         ph = stats.phases
         for k in ("senders", "validate", "execute", "commit", "seal",
-                  "collect", "save", "collect_bg", "persist_bg",
-                  "save_bg"):
+                  "collect", "save", "seal_bg", "collect_bg",
+                  "persist_bg", "save_bg"):
             ph[k] = 0.0
         t_start = time.perf_counter()
         hasher = self.hasher or host_hasher
@@ -549,6 +556,24 @@ class ReplayDriver:
                 )
             self.blockchain.storages.attach_mirror(mirror)
 
+        # cost-model-adaptive commit (sync/adaptive.py): ONE controller
+        # per replay — it outlives epoch committer rebuilds so the
+        # EWMA keeps its history. device_cap mirrors whether this
+        # driver could use the fused device path at all; the probe
+        # (when enabled) downgrades to host before window 0 on
+        # backends whose "device" memory is host RAM
+        adaptive = None
+        if self.config.sync.adaptive_commit and self.hasher is not None:
+            from khipu_tpu.sync.adaptive import AdaptiveCommitController
+
+            # the probe's calibration upload is seal-path machinery —
+            # bill it to the seal phase so bench --diff attributes it
+            # there instead of to an unattributed "?" row
+            with LEDGER.context(window=0, phase="seal"):
+                adaptive = AdaptiveCommitController(
+                    self.config.sync, device_cap=True
+                )
+
         def make_committer(parent_root: bytes) -> WindowCommitter:
             return WindowCommitter(
                 self.blockchain.storages,
@@ -567,6 +592,7 @@ class ReplayDriver:
                     if self.read_view is not None else None
                 ),
                 mirror=mirror,
+                adaptive=adaptive,
             )
 
         committer = make_committer(parent.state_root)
@@ -651,7 +677,7 @@ class ReplayDriver:
 
         def make_stage_jobs(cm: WindowCommitter, job, results, seal_tok,
                             intent_seq):
-            # the three per-stage closures one window job flows
+            # the four per-stage closures one window job flows
             # through, each ON ITS OWN COLLECTOR STAGE THREAD,
             # strictly FIFO within a stage. ``seal_tok`` (the driver's
             # window.seal span id) rides the closures across the
@@ -663,6 +689,26 @@ class ReplayDriver:
             # would split one driver's trace across two rings.
             lo, hi = results[0][0].number, results[-1][0].number
             tr = self.tracer
+
+            def seal_fn():
+                # the OFF-DRIVER seal tail: pack scan + dispatch build
+                # + upload, running while the driver executes the next
+                # window (and while the previous window's upload is in
+                # flight — the double buffering). The journal intent
+                # was fsynced on the DRIVER before this job existed,
+                # and pack mutates memory only, so the crash contract
+                # is unchanged: persist is still the first durable
+                # mutation. The LEDGER phase stays "seal" so the
+                # per-window cost model and bench --diff keep
+                # attributing the sub-phases to the seal family.
+                with use_tracer(tr):
+                    fault_point("collector.seal")
+                    t0 = time.perf_counter()
+                    with span("window.pack", parent=seal_tok,
+                              block_lo=lo, block_hi=hi), \
+                            LEDGER.context(window=lo, phase="seal"):
+                        cm.pack_and_dispatch(job)
+                    ph["seal_bg"] += time.perf_counter() - t0
 
             def collect_fn():
                 # chaos seams: a rule at any of the collector.* sites
@@ -759,7 +805,7 @@ class ReplayDriver:
                         self.read_view.retire_through(hi)
                     ph["save_bg"] += time.perf_counter() - t0
 
-            return (collect_fn, persist_fn, save_fn)
+            return (seal_fn, collect_fn, persist_fn, save_fn)
 
         def seal_and_submit() -> None:
             nonlocal results_cur, window_parent_root
@@ -795,6 +841,15 @@ class ReplayDriver:
             with span("pipeline.stall", block_lo=lo, block_hi=hi,
                       kind="submit"):
                 ph["collect"] += submit_job(run_fns)
+            # adaptive depth: the controller's seal.upload roofline
+            # verdict sizes how many windows may queue ahead of the
+            # seal stage (bytes-bound uploads overlap, fixed-overhead
+            # ones don't) — applied between windows, never mid-submit
+            if adaptive is not None and adaptive.depth_hint:
+                new_depth = max(1, adaptive.depth_hint)
+                if new_depth != collector.depth:
+                    collector.depth = new_depth
+                    PIPELINE_GAUGES["depth"] = new_depth
             window_parent_root = results_cur[-1][0].header.state_root
             results_cur = []
 

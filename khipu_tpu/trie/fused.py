@@ -44,10 +44,45 @@ import numpy as np
 
 from khipu_tpu.observability.profiler import D2H, H2D, HOST, LEDGER
 from khipu_tpu.observability.recorder import compile_log
+from khipu_tpu.observability.registry import REGISTRY
 from khipu_tpu.observability.trace import span as _span
 from khipu_tpu.ops.keccak_jnp import RATE
 
 MAX_DEPTH = 64  # DAG deeper than this falls back to the level loop
+
+FUSED_GAUGES = REGISTRY.gauge_group("khipu_fused", {
+    # dispatches that could not start the eager d2h digest copy (the
+    # backend lacks copy_to_host_async) — collect() pays the fetch
+    "async_copy_fallbacks": 0,
+}, help="fused-dispatch capability state (trie/fused.py)")
+
+# per-backend-platform capability: does the runtime support
+# copy_to_host_async? Probed on the FIRST dispatch, cached for the
+# process — every later window short-circuits instead of paying (and
+# silently swallowing) an exception per dispatch
+_ASYNC_COPY_SUPPORT: Dict[str, bool] = {}
+
+
+def _start_async_copy(arr) -> None:
+    """Begin streaming ``arr`` device->host so a later blocking fetch
+    returns without the tunnel round-trip. Capability-gated per
+    backend: unsupported backends count a gauge instead of raising
+    (InjectedDeath is a BaseException and propagates — KL002)."""
+    import jax
+
+    platform = jax.default_backend()
+    ok = _ASYNC_COPY_SUPPORT.get(platform)
+    if ok is False:
+        FUSED_GAUGES["async_copy_fallbacks"] += 1
+        return
+    try:
+        arr.copy_to_host_async()
+    except Exception:
+        _ASYNC_COPY_SUPPORT[platform] = False
+        FUSED_GAUGES["async_copy_fallbacks"] += 1
+        return
+    if ok is None:
+        _ASYNC_COPY_SUPPORT[platform] = True
 
 
 class FusedUnsupported(Exception):
@@ -105,7 +140,10 @@ class _CompileCache:
     @staticmethod
     def _label(key: tuple) -> str:
         sig, rounds, use_jnp, ext_rows = key
-        classes = ",".join(f"{nb}x{nr}/{ns}" for nb, nr, ns in sig)
+        classes = ",".join(
+            f"{s[0]}x{s[1]}/{s[2]}+a{s[3] if len(s) > 3 else 0}"
+            for s in sig
+        )
         return (
             f"classes=[{classes}] rounds={rounds} "
             f"backend={'jnp' if use_jnp else 'pallas'} ext={ext_rows}"
@@ -152,23 +190,30 @@ class _CompileCache:
             return {"size": len(self._od), "capacity": self._capacity}
 
 
-def _build_fused_impl(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
-                      use_jnp: bool, ext_rows: int = 0):
+def _build_fused_impl(sig: Tuple[Tuple[int, int, int, int], ...],
+                      rounds: int, use_jnp: bool, ext_rows: int = 0):
     """Compile the fixpoint program for a shape signature.
 
-    sig: per class (nblocks, nrows, nsubs), nrows % TILE == 0.
+    sig: per class (nblocks, nrows, nsubs, nadmit), nrows % TILE == 0.
     Inputs: for each class, enc u8[nrows, nblocks*RATE]; then for each
     class rows i32[nsubs], offs i32[nsubs], child i32[nsubs] — the
     x32 byte-index expansion happens ON DEVICE (uploading pre-expanded
     index arrays tripled the per-window transfer through the tunnel);
-    finally ext u8[ext_rows, 32] — RESOLVED-INPUT TILES: final digests
+    then ext u8[ext_rows, 32] — RESOLVED-INPUT TILES: final digests
     of a previous (possibly still in-flight) window's nodes, consumed
     device-to-device so cross-window placeholder refs resolve without
-    a host round-trip (the deep-pipeline seam — ledger/window.seal).
-    Output: concatenated digests u8[sum nrows, 32] AND the per-class
+    a host round-trip (the deep-pipeline seam — ledger/window.seal);
+    finally, for each class, aidx i32[nadmit] — the MIRROR-ADMIT rows:
+    indices of the class's live nodes, whose final encodings and
+    digests are gathered INSIDE this program (the admit gather that
+    used to be a separate post-collect d2d pass per window rides the
+    dispatch itself — ledger/window.admit_mirror fast path).
+    Output: concatenated digests u8[sum nrows, 32], the per-class
     FINAL substituted encodings (still on device) — the payload the
     device-resident commit admits into the store's mirror without any
-    node bytes crossing the tunnel (docs/window_pipeline.md).
+    node bytes crossing the tunnel (docs/window_pipeline.md) — and the
+    per-class admit gathers (enc u8[nadmit, width], claim
+    u8[nadmit, 32]; None for classes with nadmit == 0).
 
     Substitution child indices address the concatenated [G; ext] digest
     space: this window's rows first (class-major), then the ext rows —
@@ -182,17 +227,19 @@ def _build_fused_impl(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
     import jax
     import jax.numpy as jnp
 
+    # legacy 3-tuple signatures (no admit fold) normalize to nadmit=0
+    sig = tuple(s if len(s) > 3 else (*s, 0) for s in sig)
     if use_jnp:
         from khipu_tpu.ops.keccak_jnp import hash_padded_u8
 
         def _mk_runner(nb):
             return lambda padded_u8: hash_padded_u8(padded_u8, nb)
 
-        runners = [_mk_runner(nb) for nb, _, _ in sig]
+        runners = [_mk_runner(nb) for nb, _, _, _ in sig]
     else:
         from khipu_tpu.ops.keccak_pallas import _build_from_bytes
 
-        runners = [_build_from_bytes(nb, False) for nb, _, _ in sig]
+        runners = [_build_from_bytes(nb, False) for nb, _, _, _ in sig]
     k = len(sig)
 
     @jax.jit
@@ -200,6 +247,7 @@ def _build_fused_impl(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
         encs = list(args[:k])
         subs = args[k : 4 * k]
         ext = args[4 * k]  # u8[ext_rows, 32] resolved-input tiles
+        aidx = args[4 * k + 1 : 4 * k + 1 + k]  # per-class admit rows
 
         def hash_all(encs):
             return jnp.concatenate(
@@ -230,7 +278,20 @@ def _build_fused_impl(sig: Tuple[Tuple[int, int, int], ...], rounds: int,
         # rounds-1 substitution passes) and encs (rounds passes) are at
         # the fixpoint: encs carry only real child digests and
         # keccak(encs[c][r]) == digs row r of class c
-        return digs, encs
+        #
+        # fold the mirror-admit gather into THIS program: live-row
+        # encodings and their claimed digests come out pre-gathered, so
+        # admit_mirror issues zero extra device work per window
+        admit = []
+        gbase = 0
+        for c in range(k):
+            nadmit = sig[c][3]
+            if nadmit:
+                admit.append((encs[c][aidx[c]], digs[gbase + aidx[c]]))
+            else:
+                admit.append(None)
+            gbase += sig[c][1]
+        return digs, encs, admit
 
     return run
 
@@ -264,14 +325,24 @@ class FusedJob:
     the store mirror (storage/device_mirror.py) with zero node bytes
     crossing the tunnel."""
 
-    __slots__ = ("digests", "encs", "class_rows", "dpos", "_mapping")
+    __slots__ = ("digests", "encs", "class_rows", "dpos", "_mapping",
+                 "admit_tiles", "upload_nbytes", "upload_seconds")
 
-    def __init__(self, digests, class_rows, dpos=None, encs=None):
+    def __init__(self, digests, class_rows, dpos=None, encs=None,
+                 admit_tiles=None):
         self.digests = digests  # device u8[sum rows, 32]
         self.encs = encs  # per-class device u8[nrows, nb*RATE] or None
         self.class_rows = class_rows  # [(phs in row order, global base)]
         self.dpos = dpos or {}  # ph -> global row (cross-window gather)
         self._mapping: Dict[bytes, bytes] = None
+        # mirror-admit tiles gathered INSIDE the dispatch:
+        # [(nblocks, keys, enc_dev, claim_dev, lengths)] or None when
+        # the dispatch ran without admit_live (ledger/window.py)
+        self.admit_tiles = admit_tiles
+        # what the dispatch uploaded and how long the enqueue took —
+        # the adaptive controller's seal.upload roofline input
+        self.upload_nbytes = 0
+        self.upload_seconds = 0.0
 
     def fetch_rows(self, refs) -> Dict[bytes, bytes]:
         """Digests of ``refs`` ONLY: a device-to-device row gather plus
@@ -315,6 +386,7 @@ class FusedJob:
         referenced and HBM grew O(replayed chain)."""
         self.encs = None
         self.digests = None
+        self.admit_tiles = None
 
     def collect(self) -> Dict[bytes, bytes]:
         if self._mapping is not None:
@@ -372,6 +444,7 @@ def fused_submit(
     use_jnp: bool = False,
     depth: int = None,
     ext=None,
+    admit_live=None,
 ) -> FusedJob:
     """Pack + dispatch the fixpoint program that resolves placeholder ->
     real Keccak-256 hash for every entry of ``to_resolve`` (placeholder
@@ -390,6 +463,12 @@ def fused_submit(
     placeholder bytes get them substituted ON DEVICE from the tile, so
     a window can be sealed and dispatched while its predecessor is
     still hashing (the seal/collect barrier removal).
+
+    ``admit_live``: optional set/dict of placeholders whose FINAL
+    encodings + digests the caller wants gathered into whole mirror
+    tiles INSIDE the dispatch (``FusedJob.admit_tiles``) — the
+    device-resident commit's admit pass folded into this program so it
+    costs no extra device round-trip per window.
     """
     from khipu_tpu.chaos import fault_point
 
@@ -400,11 +479,15 @@ def fused_submit(
         "fused.dispatch",
         nodes=len(to_resolve),
         ext_rows=int(ext[0].shape[0]) if ext is not None else 0,
+        admit=len(admit_live) if admit_live else 0,
     ):
-        return _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext)
+        return _fused_submit(
+            to_resolve, deps, prefix, use_jnp, depth, ext, admit_live
+        )
 
 
-def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext) -> FusedJob:
+def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext,
+                  admit_live=None) -> FusedJob:
     if not to_resolve:
         return FusedJob(None, [])
     if depth is None:
@@ -452,9 +535,19 @@ def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext) -> FusedJob:
         if ext is not None:
             ext_dev, ext_pos = ext
 
+        # mirror-admit fold: per class, the row indices of live nodes
+        # padded out to whole 1024-row mirror tiles (the dummy points
+        # at the class's guaranteed padding row — a valid multi-rate-
+        # padded filler whose digest is self-consistent, so filler
+        # slots verify). Tile-count is pow-2 bucketed so window-to-
+        # window live-set jitter shares one compiled signature.
+        from khipu_tpu.storage.device_mirror import TILE as _MTILE
+
         enc_bufs: List[np.ndarray] = []
         sub_arrays: List[np.ndarray] = []
-        sig: List[Tuple[int, int, int]] = []
+        admit_bufs: List[np.ndarray] = []
+        admit_meta: List = []  # per class: (keys, lengths) or None
+        sig: List[Tuple[int, int, int, int]] = []
         for nb in class_list:
             rows = classes[nb]
             width = nb * RATE
@@ -510,7 +603,29 @@ def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext) -> FusedJob:
                     np.ascontiguousarray(sub_np[:, 2]),
                 ]
             )
-            sig.append((nb, nrows_pad[nb], nsubs))
+            aidx_list: List[int] = []
+            akeys: List = []
+            alens: List[int] = []
+            if admit_live:
+                for r, ph in enumerate(rows):
+                    if ph in admit_live:
+                        aidx_list.append(r)
+                        akeys.append(ph)
+                        alens.append(len(to_resolve[ph]))
+            if aidx_list:
+                ntiles = _pow2(-(-len(aidx_list) // _MTILE))
+                nadmit = ntiles * _MTILE
+                aidx_np = np.full(nadmit, dummy_row, dtype=np.int32)
+                aidx_np[: len(aidx_list)] = aidx_list
+                akeys.extend([None] * (nadmit - len(aidx_list)))
+                alens.extend([0] * (nadmit - len(aidx_list)))
+                admit_bufs.append(aidx_np)
+                admit_meta.append((akeys, alens))
+            else:
+                nadmit = 0
+                admit_bufs.append(np.zeros(0, dtype=np.int32))
+                admit_meta.append(None)
+            sig.append((nb, nrows_pad[nb], nsubs, nadmit))
 
         # resolved-input tile: always an input (a dummy zero tile when the
         # window has no cross-refs) so every window shares one compiled
@@ -543,6 +658,7 @@ def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext) -> FusedJob:
         # pipeline). Dispatch is async, so the measured duration is the
         # enqueue+transfer handoff, not the device compute.
         up = sum(b.nbytes for b in enc_bufs) + sum(a.nbytes for a in sub_arrays)
+        up += sum(a.nbytes for a in admit_bufs)
         if isinstance(ext_buf, np.ndarray):
             up += ext_buf.nbytes
     if LEDGER.enabled:
@@ -551,20 +667,35 @@ def _fused_submit(to_resolve, deps, prefix, use_jnp, depth, ext) -> FusedJob:
         # fixed-overhead join for seal.dispatch_build)
         LEDGER.record("seal.dispatch_build", HOST, up,
                       duration=time.perf_counter() - _build_t0)
+    _up_t0 = time.perf_counter()
     with _span("seal.upload", nbytes=up):
         with LEDGER.transfer("seal.upload", H2D, up):
             # async: no host sync
-            digests, final_encs = run(*[*enc_bufs, *sub_arrays, ext_buf])
-    try:
-        # start the device->host copy NOW: it streams as soon as the
-        # fixpoint finishes, so collect()'s device_get returns without
-        # paying the tunnel round-trip (measured 96 ms -> ~0)
-        digests.copy_to_host_async()
-    except Exception:
-        pass  # backend without async copies: collect pays the fetch
+            digests, final_encs, admit_out = run(
+                *[*enc_bufs, *sub_arrays, ext_buf, *admit_bufs]
+            )
+    _up_s = time.perf_counter() - _up_t0
+    # start the device->host copy NOW: it streams as soon as the
+    # fixpoint finishes, so collect()'s device_get returns without
+    # paying the tunnel round-trip (measured 96 ms -> ~0)
+    _start_async_copy(digests)
     class_rows = []
     base = 0
     for nb in class_list:
         class_rows.append((classes[nb], base))
         base += nrows_pad[nb]
-    return FusedJob(digests, class_rows, dpos, encs=list(final_encs))
+    admit_tiles = None
+    if admit_live:
+        admit_tiles = []
+        for c, nb in enumerate(class_list):
+            meta = admit_meta[c]
+            if meta is None or admit_out[c] is None:
+                continue
+            akeys, alens = meta
+            enc_g, claim_g = admit_out[c]
+            admit_tiles.append((nb, akeys, enc_g, claim_g, alens))
+    job = FusedJob(digests, class_rows, dpos, encs=list(final_encs),
+                   admit_tiles=admit_tiles)
+    job.upload_nbytes = up
+    job.upload_seconds = _up_s
+    return job

@@ -82,6 +82,9 @@ def chain():
 
 
 def _cfg(window=2, depth=2, degrade=True):
+    # adaptive_commit off: chaos plans target fault seams on the
+    # CONFIGURED path; the adaptive controller would route CPU runs to
+    # host commit and the device seams would never fire
     return dataclasses.replace(
         CFG,
         sync=SyncConfig(
@@ -90,6 +93,7 @@ def _cfg(window=2, depth=2, degrade=True):
             pipeline_depth=depth,
             degrade_on_collector_death=degrade,
             collector_join_timeout=5.0,
+            adaptive_commit=False,
         ),
     )
 
@@ -339,6 +343,60 @@ class TestCrashRecovery:
         assert report.rolled_back >= 1
         assert bc.storages.window_journal.pending() == []
 
+        resume_cfg = _cfg(window=1, depth=1)
+        ReplayDriver(bc, resume_cfg).replay(
+            chain[bc.best_block_number:]
+        )
+        _assert_same_chain(bc, _clean_reference(chain))
+
+    def test_kill_between_seal_and_pack_rolls_back(self, chain):
+        """Death ON the new driver->seal-stage boundary: the driver
+        already fsynced the window's journal intent and handed the job
+        off, but the seal stage dies BEFORE the pack scan touches
+        anything. Nothing of the window is durable, so recovery sees a
+        bare intent and rolls it back; the resume lands bit-exact."""
+        cfg = _cfg(window=2, depth=2, degrade=False)
+        bc = _fresh(cfg)
+        plan = FaultPlan(
+            seed=13, rules=[FaultRule("collector.seal", "die", after=2,
+                                      times=1)]
+        )
+        with active(plan):
+            with pytest.raises(CollectorDied):
+                ReplayDriver(bc, cfg).replay(chain)
+        assert [s for (s, _, _, _) in plan.fired] == ["collector.seal"]
+
+        report = ReplayDriver(bc, cfg).recover()
+        assert report.scanned >= 1
+        assert report.rolled_back >= 1
+        assert bc.storages.window_journal.pending() == []
+        resume_cfg = _cfg(window=1, depth=1)
+        ReplayDriver(bc, resume_cfg).replay(
+            chain[bc.best_block_number:]
+        )
+        _assert_same_chain(bc, _clean_reference(chain))
+
+    def test_kill_mid_pack_rolls_back(self, chain):
+        """Death INSIDE the off-driver pack (collector.pack fires after
+        the placeholder scan, before the fused dispatch): the window's
+        encodings were read but nothing was dispatched or persisted.
+        The intent fsynced on the driver before handoff makes the torn
+        window visible to recovery, which rolls it back."""
+        cfg = _cfg(window=2, depth=2, degrade=False)
+        bc = _fresh(cfg)
+        plan = FaultPlan(
+            seed=17, rules=[FaultRule("collector.pack", "die", after=1,
+                                      times=1)]
+        )
+        with active(plan):
+            with pytest.raises(CollectorDied):
+                ReplayDriver(bc, cfg).replay(chain)
+        assert [s for (s, _, _, _) in plan.fired] == ["collector.pack"]
+
+        report = ReplayDriver(bc, cfg).recover()
+        assert report.scanned >= 1
+        assert report.rolled_back >= 1
+        assert bc.storages.window_journal.pending() == []
         resume_cfg = _cfg(window=1, depth=1)
         ReplayDriver(bc, resume_cfg).replay(
             chain[bc.best_block_number:]
@@ -686,11 +744,13 @@ class TestStagedPipelineSweep:
     def test_stage_boundary_die_sweep_120_seeds(self, chain):
         """The async-spill analog of the 120-seed corruption sweep:
         seeded deaths across every stage boundary of the staged
-        collector (rootcheck/admit -> spill -> save -> commit mark,
-        plus the mid-spill seam). Whatever the seed kills, journal
-        recovery plus a serial resume must land on the bit-exact
-        chain — a torn window is NEVER silently half-durable."""
-        sites = ("collector.collect", "collector.persist",
+        collector (seal-stage entry -> mid-pack -> rootcheck/admit ->
+        spill -> save -> commit mark, plus the mid-spill seam).
+        Whatever the seed kills, journal recovery plus a serial resume
+        must land on the bit-exact chain — a torn window is NEVER
+        silently half-durable."""
+        sites = ("collector.seal", "collector.pack",
+                 "collector.collect", "collector.persist",
                  "collector.spill", "collector.save",
                  "collector.commit")
         ref = _clean_reference(chain)

@@ -308,6 +308,7 @@ def test_seal_scan_matches_resolution_inputs():
     want_resolve, want_deps, _ = resolution_inputs(trie)
 
     job = committer.seal()
+    committer.pack_and_dispatch(job)  # seal() defers the pack scan
     assert set(job.to_resolve) == set(want_resolve)
     # seal pre-substitutes resolved placeholders; with none resolved
     # yet the encodings must be byte-identical too

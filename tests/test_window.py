@@ -261,11 +261,15 @@ class TestWindowedReplay:
 
 
 def pipeline_cfg(w, depth, parallel=True):
+    # adaptive_commit off: these tests assert the CONFIGURED commit
+    # path and a fixed pipeline depth; the adaptive controller would
+    # (correctly) fall back to host commit on the CPU backend and
+    # resize the depth, defeating the assertions
     return dataclasses.replace(
         CFG,
         sync=SyncConfig(
             parallel_tx=parallel, commit_window_blocks=w,
-            pipeline_depth=depth,
+            pipeline_depth=depth, adaptive_commit=False,
         ),
     )
 
@@ -362,11 +366,15 @@ class TestDeepPipeline:
         )
         put_range(fused, range(30))
         job1 = fused.seal()
+        # seal() is now the cheap driver close-out; the pack + dispatch
+        # live in pack_and_dispatch (the collector's seal stage)
+        fused.pack_and_dispatch(job1)
         assert job1.fused_job is not None, "fused path not taken"
         assert fused._inflight_rows, "window 1 not registered in flight"
         put_range(fused, range(30, 60))
         root_ref = fused.account_trie.force_hashed_root()
         job2 = fused.seal()
+        fused.pack_and_dispatch(job2)  # packs against in-flight window 1
         # prove the cross-window mechanism was exercised: window 2's
         # packed encodings still embed window-1 placeholder bytes
         w1_phs = set(job1.to_resolve)
