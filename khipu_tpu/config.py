@@ -104,6 +104,27 @@ class SyncConfig:
     block_resolving_depth: int = 20
     parallel_tx: bool = True  # optimistic parallel execution (P1)
     tx_workers: int = 8  # worker pool width (TxProcessor.scala:29 role)
+    # conflict-aware scheduled execution (ledger/schedule.py): predict
+    # read/write sets, pack disjoint batches, vectorize plain-transfer
+    # batches (ledger/batch_exec.py), serial residue for everything
+    # unpredictable; mispredictions fall back to the optimistic path
+    # whole-block. False = always optimistic (the P1 oracle). Only
+    # engages for Byzantium+ blocks (pre-Byzantium receipts embed
+    # intermediate roots, which forbid out-of-order execution)
+    scheduled_tx: bool = True
+    # pipelined sender recovery (sync/prefetch.py): a prefetch thread
+    # recovers senders for upcoming blocks while the driver executes
+    # the current window, with a process-wide (preimage, v, r, s) ->
+    # sender cache so re-imports/reorgs never pay recovery twice
+    sender_prefetch: bool = True
+    sender_prefetch_depth: int = 8  # blocks buffered ahead of driver
+    sender_cache_entries: int = 65536  # LRU cap (~100 B/entry)
+    # batch the per-tx signing-hash keccaks through ops.keccak when a
+    # TPU backend is up (one device call per block instead of N host
+    # hashes). Host keccak is native C (~7 us/hash), so the batch path
+    # only engages where the device genuinely wins; on CPU backends
+    # this knob is a no-op
+    sender_batch_hash: bool = True
     # fast-sync pivot choice (FastSyncService.scala:184-273 role)
     min_peers_to_choose_pivot: int = 5
     pivot_block_offset: int = 500  # pivot = median(best) - offset
@@ -315,8 +336,17 @@ class TelemetryConfig:
     # close-out (anything above 0.3 means pack work leaked back onto
     # the driver); the heavy pack+upload lives in window.pack, which on
     # an overlapped pipeline should stay under ~0.85 of phase time.
+    # "senders" is the driver-foreground share of sender recovery: with
+    # the prefetch stage landed it should be near zero (cache hits) —
+    # above 0.45 means prefetch leaked back onto the driver (thread
+    # dead, cache thrashing, or prefetch disabled in a config that
+    # expects it). "execute" guards the scheduled fast path the same
+    # way: sustained > 0.9 means the batch executor stopped carrying
+    # its share (e.g. everything mispredicting into fallback).
     phase_share_ceilings: tuple = (("window.seal", 0.3),
-                                   ("window.pack", 0.85),)
+                                   ("window.pack", 0.85),
+                                   ("senders", 0.45),
+                                   ("execute", 0.9),)
     # don't judge shares until this much canonical phase time has been
     # observed (a 0.1 s startup blip trivially exceeds any ceiling)
     phase_share_min_total_s: float = 5.0

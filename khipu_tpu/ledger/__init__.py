@@ -15,6 +15,12 @@ from khipu_tpu.ledger.ledger import (
     ValidationAfterExecError,
     execute_block,
     execute_transaction,
+    shutdown_exec_pool,
+)
+from khipu_tpu.ledger.schedule import (
+    Misprediction,
+    plan_block,
+    reset_templates,
 )
 from khipu_tpu.ledger.world import BlockWorldState, TrieStorage
 
@@ -22,6 +28,7 @@ __all__ = [
     "BlockExecutionError",
     "BlockResult",
     "BlockWorldState",
+    "Misprediction",
     "Stats",
     "TrieStorage",
     "TxResult",
@@ -31,4 +38,7 @@ __all__ = [
     "bloom_union",
     "execute_block",
     "execute_transaction",
+    "plan_block",
+    "reset_templates",
+    "shutdown_exec_pool",
 ]

@@ -4,9 +4,14 @@ rewards, and the post-execution bit-exactness gate.
 Parity: ledger/Ledger.scala:95 —
   executeBlock:230            -> execute_block (parallel attempt,
                                  sequential fallback :250-271)
-  executeTransactions_inparallel:337 -> _execute_parallel (fresh world
-                                 per tx from the parent root :354,
-                                 serial merge + re-execute :393-434)
+  executeTransactions_inparallel:337 -> _execute_optimistic (fresh
+                                 world per tx from the parent root
+                                 :354, serial merge + re-execute
+                                 :393-434); _execute_scheduled is the
+                                 conflict-aware front end (schedule.py
+                                 plans, batch_exec.py vectorizes the
+                                 plain-transfer batches, optimistic is
+                                 the misprediction fallback)
   validateAndExecuteTransaction:517 -> _validate_stx + execute_transaction
   prepareProgramContext:660   -> inside execute_transaction
   runVM:710                   -> khipu_tpu.evm.vm
@@ -33,10 +38,11 @@ native EVM (the algebra and its tests are identical either way).
 
 from __future__ import annotations
 
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import Callable, List, Optional, Set, Tuple, Union
+from typing import Callable, Dict, List, Optional, Set, Tuple, Union
 
 from khipu_tpu.base.crypto.secp256k1 import HALF_N
 from khipu_tpu.config import KhipuConfig
@@ -49,6 +55,7 @@ from khipu_tpu.evm.vm import BlockEnv, MessageEnv
 from khipu_tpu.ledger.bloom import bloom_of_logs, bloom_union
 from khipu_tpu.ledger.rewards import block_rewards
 from khipu_tpu.ledger.world import BlockWorldState
+from khipu_tpu.observability.profiler import HOST, LEDGER
 
 
 class BlockExecutionError(Exception):
@@ -78,17 +85,59 @@ class TxResult:
 
 @dataclass
 class Stats:
-    """Per-block perf stats (Ledger.Stats, Ledger.scala:56-58)."""
+    """Per-block perf stats (Ledger.Stats, Ledger.scala:56-58).
+
+    ``parallel_count`` counts txs that merged without serial re-run
+    (optimistic path) or executed inside a scheduled batch;
+    ``conflict_count`` counts serial re-executions (optimistic) or
+    predicted txs a conflict edge pushed past batch 0 (scheduled) —
+    the same "how contended was this block" signal either way.
+    """
 
     tx_count: int = 0
     parallel_count: int = 0
     conflict_count: int = 0
     gas_used: int = 0
     exec_seconds: float = 0.0
+    fast_path_txs: int = 0  # txs through the vectorized batch executor
+    residue_txs: int = 0  # txs through the serial interpreter residue
+    mispredicted_txs: int = 0  # scheduled attempts discarded post-hoc
 
     @property
     def parallel_rate(self) -> float:
         return self.parallel_count / self.tx_count if self.tx_count else 1.0
+
+
+# Process-wide executor pool for the optimistic path: one block per
+# driver at a time uses it, and rebuilding a ThreadPoolExecutor per
+# block (the old `with` form) paid thread spawn+join on EVERY block.
+# Sized from the first caller's config; resized only if the width
+# changes; shut down via ServiceBoard.shutdown() (and tests).
+_EXEC_POOL: Optional[ThreadPoolExecutor] = None
+_EXEC_POOL_WIDTH = 0
+_EXEC_POOL_LOCK = threading.Lock()
+
+
+def _exec_pool(workers: int) -> ThreadPoolExecutor:
+    global _EXEC_POOL, _EXEC_POOL_WIDTH
+    with _EXEC_POOL_LOCK:
+        if _EXEC_POOL is None or _EXEC_POOL_WIDTH != workers:
+            if _EXEC_POOL is not None:
+                _EXEC_POOL.shutdown(wait=False)
+            _EXEC_POOL = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="khipu-exec"
+            )
+            _EXEC_POOL_WIDTH = workers
+        return _EXEC_POOL
+
+
+def shutdown_exec_pool() -> None:
+    global _EXEC_POOL, _EXEC_POOL_WIDTH
+    with _EXEC_POOL_LOCK:
+        if _EXEC_POOL is not None:
+            _EXEC_POOL.shutdown(wait=True)
+            _EXEC_POOL = None
+            _EXEC_POOL_WIDTH = 0
 
 
 @dataclass
@@ -341,10 +390,41 @@ def execute_block(
         set_trace(_trace)
     try:
         if khipu_config.sync.parallel_tx and len(txs) > 1 and not traced:
-            world, receipts, gas_used = _execute_parallel(
-                config, block_env, txs, senders, parent_state_root,
-                make_world, header, khipu_config.sync.tx_workers, stats,
-            )
+            world = receipts = gas_used = None
+            # pre-Byzantium receipts embed intermediate state roots, so
+            # out-of-index-order batch execution would corrupt them —
+            # the scheduler only runs where receipts carry status codes
+            if khipu_config.sync.scheduled_tx and config.byzantium:
+                from khipu_tpu.ledger.schedule import (
+                    EXEC_GAUGES,
+                    Misprediction,
+                )
+
+                try:
+                    world, receipts, gas_used = _execute_scheduled(
+                        config, block_env, txs, senders,
+                        parent_state_root, make_world, header, stats,
+                    )
+                except (Misprediction, TxValidationError) as e:
+                    # the scheduled attempt is void: discard its world
+                    # AND its stats, then re-run the whole block on the
+                    # optimistic path, which owns the authoritative
+                    # outcome (correctness never depends on prediction)
+                    if isinstance(e, Misprediction):
+                        stats.mispredicted_txs += 1
+                        EXEC_GAUGES["mispredictions"] += 1
+                    EXEC_GAUGES["fallbacks"] += 1
+                    stats.parallel_count = 0
+                    stats.conflict_count = 0
+                    stats.fast_path_txs = 0
+                    stats.residue_txs = 0
+                    world = None
+            if world is None:
+                world, receipts, gas_used = _execute_optimistic(
+                    config, block_env, txs, senders, parent_state_root,
+                    make_world, header, khipu_config.sync.tx_workers,
+                    stats,
+                )
         else:
             world, receipts, gas_used = _execute_sequential(
                 config, block_env, txs, senders, parent_state_root,
@@ -387,6 +467,171 @@ def _execute_sequential(
     return world, receipts, cumulative
 
 
+def _execute_scheduled(
+    config, block_env, txs, senders, parent_root, make_world, header,
+    stats: Stats,
+):
+    """Conflict-aware scheduled execution (schedule.plan_block) on ONE
+    merged world — zero merge conflicts by construction.
+
+    Steps run in plan order: each batch's plain transfers go through
+    the vectorized executor, its template calls through the
+    interpreter with their ACTUAL footprint captured and checked
+    against the prediction; a residue tx is a barrier — every earlier
+    tx's fee posts first (post_through), so it observes the exact
+    sequential state. Receipts, fees, and the cumulative block-gas
+    rule are applied strictly in index order regardless of execution
+    order; no predicted tx may touch the beneficiary (the planner
+    routes those to the residue), so deferring fee posting is
+    invisible.
+
+    Raises schedule.Misprediction or TxValidationError to demand the
+    whole-block optimistic fallback (caller: execute_block).
+    """
+    from khipu_tpu.ledger.batch_exec import execute_fast_batch
+    from khipu_tpu.ledger.schedule import (
+        CALL,
+        EMPTY_CODE_HASH,
+        LEARNER,
+        Misprediction,
+        footprint_ok,
+        plan_block,
+    )
+
+    merged = make_world(parent_root)
+    plan = plan_block(
+        txs, senders, header.beneficiary, merged.get_code_hash, LEARNER
+    )
+    stats.conflict_count += plan.conflicted
+
+    receipts: List[Receipt] = []
+    outcomes: List[Optional[TxResult]] = [None] * len(txs)
+    cumulative = 0
+    accumulated_gas = 0
+    posted = 0
+
+    def post_through(limit: int) -> None:
+        """Post fees + receipts for txs [posted, limit) in index order
+        (they have all executed). The cumulative block-gas rule (YP
+        eq. 58) is enforced HERE, against the true running total —
+        batch execution validated with accumulated_gas=0, exactly like
+        the optimistic pass."""
+        nonlocal cumulative, accumulated_gas, posted
+        while posted < limit:
+            r = outcomes[posted]
+            if accumulated_gas + txs[posted].tx.gas_limit > header.gas_limit:
+                raise TxValidationError(
+                    posted, "cumulative gas above block limit"
+                )
+            accumulated_gas += r.gas_used
+            cumulative = _tx_post(
+                config, merged, r, header.beneficiary, cumulative, receipts
+            )
+            posted += 1
+
+    def run_captured(i: int, accumulated: int) -> Dict[str, Set]:
+        """Validate + execute tx i on the merged world with fresh
+        reads/written dicts swapped in, so the tx's ACTUAL footprint
+        is observable. Adopts the result world as ``merged``, unions
+        the captured sets back, and returns them. Exploits copy()
+        semantics: call-frame checkpoints share ``reads`` by reference
+        and copy ``written`` — so reads survive reverts (as required)
+        and the final world's ``written`` is the tx's true write set."""
+        nonlocal merged
+        saved_reads, saved_written = merged.reads, merged.written
+        merged.reads = {k: set() for k in saved_reads}
+        merged.written = {k: set() for k in saved_written}
+        _validate_stx(
+            txs[i], senders[i], config, merged, accumulated,
+            header.gas_limit, i,
+        )
+        r = execute_transaction(config, merged, block_env, txs[i], senders[i])
+        world = r.world  # call frames fork copies; adopt the final one
+        captured = {"reads": world.reads, "written": world.written}
+        for cat in saved_reads:
+            saved_reads[cat] |= world.reads[cat]
+            saved_written[cat] |= world.written[cat]
+        world.reads = saved_reads
+        world.written = saved_written
+        merged = world
+        outcomes[i] = r
+        return captured
+
+    for step in plan.steps:
+        if step.kind == "residue":
+            i = step.indices[0]
+            post_through(i)  # the residue sees exact sequential state
+            tx = txs[i].tx
+            code_hash = (
+                merged.get_code_hash(tx.to) if tx.to is not None else None
+            )
+            _t0 = time.perf_counter()
+            captured = run_captured(i, accumulated_gas)
+            # host-side classification event: per-tx interpreter time,
+            # so bench --diff attributes execute-phase movement to the
+            # residue vs the vectorized batches
+            LEDGER.record(
+                "exec.residue", HOST, 0,
+                duration=time.perf_counter() - _t0,
+            )
+            stats.residue_txs += 1
+            if (
+                code_hash is not None
+                and code_hash != EMPTY_CODE_HASH
+                and senders[i] is not None
+                and outcomes[i].error is None
+                and outcomes[i].status == 1
+            ):
+                # teach the learner from successful template-shaped
+                # calls only — error/revert paths have partial
+                # footprints that would under-predict
+                LEARNER.observe(
+                    code_hash, senders[i], tx.to, tx.payload,
+                    captured["reads"], captured["written"],
+                )
+            post_through(i + 1)
+            continue
+        fast_items = []
+        for i in step.indices:
+            if plan.predicted[i].kind == CALL:
+                pred = plan.predicted[i]
+                code_hash = merged.get_code_hash(txs[i].tx.to)
+                _t0 = time.perf_counter()
+                captured = run_captured(i, 0)
+                # template calls run the interpreter too — same cost
+                # bucket as the residue (per-tx EVM time)
+                LEDGER.record(
+                    "exec.residue", HOST, 0,
+                    duration=time.perf_counter() - _t0,
+                )
+                if not footprint_ok(
+                    pred, captured["reads"], captured["written"]
+                ):
+                    LEARNER.demote(code_hash)
+                    raise Misprediction(
+                        i, "actual footprint escaped prediction"
+                    )
+                stats.parallel_count += 1
+            else:
+                fast_items.append((i, txs[i], senders[i]))
+        if fast_items:
+            _t0 = time.perf_counter()
+            results = execute_fast_batch(config, merged, fast_items)
+            # host-side classification event: vectorized fast-path
+            # time per batch (joins with exec.residue for the execute
+            # cost-model breakdown)
+            LEDGER.record(
+                "exec.batch", HOST, 0,
+                duration=time.perf_counter() - _t0,
+            )
+            for (i, _, _), r in zip(fast_items, results):
+                outcomes[i] = r
+            stats.fast_path_txs += len(fast_items)
+            stats.parallel_count += len(fast_items)
+    post_through(len(txs))
+    return merged, receipts, cumulative
+
+
 def _run_one(
     config: EvmConfig,
     make_world: Callable[[], BlockWorldState],
@@ -408,24 +653,25 @@ def _run_one(
     return execute_transaction(config, world, block_env, stx, sender)
 
 
-def _execute_parallel(
+def _execute_optimistic(
     config, block_env, txs, senders, parent_root, make_world, header,
     workers, stats: Stats,
 ):
     """Optimistic parallel execution + serial merge (P1,
-    Ledger.scala:337-461)."""
+    Ledger.scala:337-461) — the oracle the scheduled path falls back
+    to on any misprediction, and the default for pre-Byzantium blocks."""
     import os
 
     if (os.cpu_count() or 1) > 1:
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            futures = [
-                pool.submit(
-                    _run_one, config, lambda: make_world(parent_root),
-                    block_env, txs[i], senders[i], i, header.gas_limit,
-                )
-                for i in range(len(txs))
-            ]
-            outcomes = [f.result() for f in futures]
+        pool = _exec_pool(workers)
+        futures = [
+            pool.submit(
+                _run_one, config, lambda: make_world(parent_root),
+                block_env, txs[i], senders[i], i, header.gas_limit,
+            )
+            for i in range(len(txs))
+        ]
+        outcomes = [f.result() for f in futures]
     else:
         # one core: threads only add scheduling overhead — run the
         # SAME optimistic attempts inline (identical snapshot + merge

@@ -1,0 +1,459 @@
+"""Conflict-aware static transaction scheduling (the execute stage).
+
+The paper's first research claim — ~80% of a block's transactions
+execute in parallel — was reproduced only structurally by the
+optimistic path (ledger._execute_optimistic): every tx runs against a
+parent-root snapshot and conflicts are discovered AFTER the fact, in
+the serial merge. This module inverts that: predict each tx's
+read/write footprint BEFORE execution, pack predicted-disjoint txs
+into maximal batches via greedy precedence-respecting coloring, and
+route everything unpredictable to a serial residue. Batches then
+execute with zero merge conflicts BY CONSTRUCTION (the fast path
+skips the snapshot+merge machinery entirely); a post-hoc comparison
+of actual vs predicted touched sets catches every misprediction and
+falls the whole block back to the optimistic path — correctness never
+depends on a prediction being right (Block-STM-style scheduled OCC,
+but scheduling conflicts away up front instead of aborting into
+them).
+
+Footprint algebra (mirrors the world's merge categories):
+
+* ``acct_r``  — account-state reads (nonce/balance/existence). The
+  validation nonce+balance probe and the EIP-161 emptiness sweep.
+* ``acct_w``  — ABSOLUTE account writes (save/delete). Predicted tx
+  shapes never produce these; anything that would is residue.
+* ``acct_d``  — commutative delta writes (add_balance /
+  increase_nonce). D∩D overlaps are NOT conflicts — two credits to
+  one address commute exactly, the same rule the optimistic merge
+  applies (world.add_balance records no read).
+* ``slots``   — (address, key) storage cells, treated read+write
+  (SSTORE is last-writer, never commutative).
+* ``code_r``  — code reads. Nothing in predicted-land writes code
+  (creations are residue barriers), so code reads never conflict;
+  the set only participates in the misprediction ⊆ check.
+
+Two predicted txs conflict when a read meets a write/delta, a write
+meets anything, or storage slots intersect. Conflicting pairs keep
+index order (a later conflicting tx is assigned a strictly greater
+batch), so every non-commutative effect is applied in sequential
+order and everything else commutes — the scheduled block is bit-exact
+against the serial fold.
+
+ERC-20-style calls are predicted by a per-code-hash TEMPLATE LEARNER:
+the first call to an unknown code hash runs in the residue with its
+footprint captured; every observed storage slot must derive from the
+tx's own fields (int(sender), int(arg_i), or the Solidity mapping
+form keccak(pad32(x) ++ pad32(k))) for the code hash to earn a
+template. Underivable slots (state-dependent indexing) mark the hash
+OPAQUE — permanently residue. A template whose prediction a later tx
+violates is demoted to opaque and the block falls back.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.domain.account import EMPTY_CODE_HASH
+from khipu_tpu.domain.transaction import contract_address
+from khipu_tpu.ledger.world import (
+    ON_ACCOUNT,
+    ON_ADDRESS,
+    ON_CODE,
+    ON_STORAGE,
+)
+
+try:  # one registry family for the whole execute stage
+    from khipu_tpu.observability.registry import REGISTRY
+
+    EXEC_GAUGES = REGISTRY.gauge_group("khipu_exec_batch", {
+        "planned_blocks": 0,
+        "fast_txs": 0,
+        "call_txs": 0,
+        "residue_txs": 0,
+        "batches": 0,
+        "max_batch_width": 0,
+        "mispredictions": 0,
+        "fallbacks": 0,
+        "templates": 0,
+        "opaque_codes": 0,
+    }, help="conflict-aware execute-stage scheduler (ledger/schedule.py)")
+except Exception:  # pragma: no cover - stdlib-only fallback
+    EXEC_GAUGES = {
+        k: 0 for k in (
+            "planned_blocks", "fast_txs", "call_txs", "residue_txs",
+            "batches", "max_batch_width", "mispredictions", "fallbacks",
+            "templates", "opaque_codes",
+        )
+    }
+
+
+class Misprediction(Exception):
+    """A predicted tx touched state outside its predicted footprint —
+    the scheduled execution is discarded and the block re-runs on the
+    optimistic path (which never trusts predictions)."""
+
+    def __init__(self, index: int, detail: str):
+        super().__init__(f"tx[{index}]: {detail}")
+        self.index = index
+        self.detail = detail
+
+
+# classification kinds
+FAST = "fast"  # plain value transfer -> vectorized batch executor
+CALL = "call"  # learned template call -> interpreter, footprint-checked
+RESIDUE = "residue"  # serial barrier on the merged world
+
+# precompile / reserved address range routed to the residue: precompile
+# dispatch keys on code_address, so a "plain transfer" to 0x01..0x09
+# actually runs a precompile
+_RESERVED_ADDR_MAX = 0xFFFF
+
+
+
+@dataclass(frozen=True)
+class Predicted:
+    """A tx's predicted footprint in the conflict algebra above."""
+
+    kind: str
+    acct_r: frozenset
+    acct_d: frozenset
+    slots: frozenset  # of (address, key) — read+write
+    code_r: frozenset
+    acct_w: frozenset = frozenset()
+
+
+@dataclass
+class Step:
+    kind: str  # "batch" | "residue"
+    indices: List[int]
+
+
+@dataclass
+class Plan:
+    steps: List[Step] = field(default_factory=list)
+    predicted: Dict[int, Predicted] = field(default_factory=dict)
+    n_fast: int = 0
+    n_call: int = 0
+    n_residue: int = 0
+    conflicted: int = 0  # predicted txs pushed past batch 0 by an edge
+    max_width: int = 0
+
+
+# ----------------------------------------------------- template learner
+
+
+_OPAQUE = "opaque"
+
+
+@dataclass(frozen=True)
+class Template:
+    """Slot derivation rules for one code hash. Each rule recomputes a
+    predicted slot from the CALLING tx's own fields."""
+
+    rules: Tuple[tuple, ...]
+
+
+def _pad32(v: int) -> bytes:
+    return v.to_bytes(32, "big")
+
+
+def _arg_words(payload: bytes, limit: int = 8) -> List[int]:
+    """Calldata as CALLDATALOAD-style 32-byte words (zero right-pad)."""
+    words = []
+    for i in range(min(limit, (len(payload) + 31) // 32)):
+        words.append(
+            int.from_bytes(payload[32 * i:32 * i + 32].ljust(32, b"\x00"),
+                           "big")
+        )
+    return words
+
+
+_MAP_SLOTS = 4  # mapping base slots probed for the keccak derivation
+
+
+def _derive_rules(slot: int, sender_i: int, args: List[int]) -> List[tuple]:
+    """Every derivation rule that reproduces ``slot`` from this tx."""
+    rules = []
+    if slot == sender_i:
+        rules.append(("caller",))
+    for i, a in enumerate(args):
+        if slot == a:
+            rules.append(("arg", i))
+    for k in range(_MAP_SLOTS):
+        if slot == int.from_bytes(
+                keccak256(_pad32(sender_i) + _pad32(k)), "big"):
+            rules.append(("map_caller", k))
+    for i, a in enumerate(args):
+        for k in range(_MAP_SLOTS):
+            if slot == int.from_bytes(
+                    keccak256(_pad32(a) + _pad32(k)), "big"):
+                rules.append(("map_arg", i, k))
+    return rules
+
+
+def _apply_rules(rules: Tuple[tuple, ...], sender_i: int,
+                 args: List[int]) -> Optional[frozenset]:
+    """Predicted slot keys for a new tx, or None when a rule's arg
+    index is absent from this calldata (prediction impossible)."""
+    slots = set()
+    for rule in rules:
+        tag = rule[0]
+        if tag == "caller":
+            slots.add(sender_i)
+        elif tag == "arg":
+            if rule[1] >= len(args):
+                return None
+            slots.add(args[rule[1]])
+        elif tag == "map_caller":
+            slots.add(int.from_bytes(
+                keccak256(_pad32(sender_i) + _pad32(rule[1])), "big"))
+        elif tag == "map_arg":
+            if rule[1] >= len(args):
+                return None
+            slots.add(int.from_bytes(
+                keccak256(_pad32(args[rule[1]]) + _pad32(rule[2])), "big"))
+    return frozenset(slots)
+
+
+class TemplateLearner:
+    """Per-code-hash slot templates, learned from residue executions.
+
+    Thread-safe; process-global by default (templates are properties
+    of bytecode, not of a chain). A misprediction demotes the hash to
+    opaque forever — the learner never oscillates."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[bytes, object] = {}
+
+    def lookup(self, code_hash: bytes) -> Optional[object]:
+        """Template, the string "opaque", or None (never observed)."""
+        with self._lock:
+            return self._entries.get(code_hash)
+
+    def demote(self, code_hash: bytes) -> None:
+        with self._lock:
+            if self._entries.get(code_hash) is not _OPAQUE:
+                self._entries[code_hash] = _OPAQUE
+                EXEC_GAUGES["opaque_codes"] += 1
+
+    def observe(self, code_hash: bytes, sender: bytes, to: bytes,
+                payload: bytes, reads: Dict[str, set],
+                written: Dict[str, set]) -> None:
+        """Learn from one residue execution's captured footprint. Only
+        ever PROMOTES unknown -> template/opaque; an existing verdict
+        stands (demotions happen solely through demote())."""
+        with self._lock:
+            if code_hash in self._entries:
+                return
+        verdict: object = _OPAQUE
+        ok = (
+            not written[ON_CODE]
+            and not written[ON_ADDRESS]
+            and reads[ON_ACCOUNT] <= {sender, to}
+            and reads[ON_ADDRESS] <= {sender, to}
+            and written[ON_ACCOUNT] <= {sender, to}
+            and reads[ON_CODE] <= {to}
+        )
+        if ok:
+            sender_i = int.from_bytes(sender, "big")
+            args = _arg_words(payload)
+            rules: List[tuple] = []
+            for addr, key in reads[ON_STORAGE] | written[ON_STORAGE]:
+                if addr != to:
+                    ok = False
+                    break
+                matched = _derive_rules(key, sender_i, args)
+                if not matched:
+                    ok = False
+                    break
+                for r in matched:
+                    if r not in rules:
+                        rules.append(r)
+            if ok:
+                verdict = Template(tuple(rules))
+        with self._lock:
+            if code_hash not in self._entries:
+                self._entries[code_hash] = verdict
+                EXEC_GAUGES[
+                    "templates" if verdict is not _OPAQUE
+                    else "opaque_codes"
+                ] += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+# the process-global learner (bytecode templates are chain-agnostic);
+# tests reset it between independent chains via reset_templates()
+LEARNER = TemplateLearner()
+
+
+def reset_templates() -> None:
+    LEARNER.reset()
+
+
+# --------------------------------------------------------- the planner
+
+
+def _classify(stx, sender: Optional[bytes], beneficiary: bytes,
+              created: set, code_hash_of: Callable[[bytes], bytes],
+              learner: TemplateLearner) -> Optional[Predicted]:
+    """Predicted footprint for one tx, or None -> residue."""
+    tx = stx.tx
+    to = tx.to
+    if sender is None or to is None:
+        return None  # unrecoverable sig / contract creation
+    if sender == beneficiary or to == beneficiary:
+        # fees post lazily in index order; a tx whose footprint could
+        # touch the coinbase must see the sequential-exact balance
+        return None
+    if to in created or sender in created:
+        # a top-level creation earlier in this block may deposit code
+        # at this address — the parent-state code probe below would lie
+        return None
+    if int.from_bytes(to, "big") <= _RESERVED_ADDR_MAX:
+        return None  # precompile dispatch keys on the code address
+    code_hash = code_hash_of(to)
+    if code_hash == EMPTY_CODE_HASH:
+        if tx.value == 0 or sender == to:
+            # zero-value / self transfers take the touch-only shortcut
+            # in world.transfer — different mark+EIP-161 semantics than
+            # the vectorized path models
+            return None
+        return Predicted(
+            kind=FAST,
+            acct_r=frozenset((sender,)),
+            acct_d=frozenset((sender, to)),
+            slots=frozenset(),
+            code_r=frozenset((to,)),
+        )
+    verdict = learner.lookup(code_hash)
+    if verdict is None or verdict is _OPAQUE:
+        return None  # unknown (observe in residue) or opaque
+    sender_i = int.from_bytes(sender, "big")
+    slots = _apply_rules(verdict.rules, sender_i, _arg_words(tx.payload))
+    if slots is None:
+        return None
+    acct_d = {sender}
+    if tx.value:
+        acct_d.add(to)
+    return Predicted(
+        kind=CALL,
+        acct_r=frozenset((sender, to)),
+        acct_d=frozenset(acct_d),
+        slots=frozenset((to, s) for s in slots),
+        code_r=frozenset((to,)),
+    )
+
+
+def plan_block(txs: Sequence, senders: Sequence[Optional[bytes]],
+               beneficiary: bytes,
+               code_hash_of: Callable[[bytes], bytes],
+               learner: Optional[TemplateLearner] = None) -> Plan:
+    """Pack a block into maximal predicted-disjoint batches.
+
+    Greedy precedence-respecting coloring: a tx's batch is one past
+    the highest batch of any EARLIER conflicting tx, so every
+    conflicting pair preserves index order while disjoint txs share a
+    batch. A residue tx is a total barrier — all earlier steps run
+    (and post fees) before it, all later txs start fresh after it.
+    """
+    learner = learner if learner is not None else LEARNER
+    plan = Plan()
+    # top-level creation addresses: their code lands mid-block, so any
+    # tx targeting one must not trust the parent-state code probe
+    created = set()
+    for i, stx in enumerate(txs):
+        if stx.tx.to is None and senders[i] is not None:
+            created.add(contract_address(senders[i], stx.tx.nonce))
+
+    open_batches: List[List[int]] = []  # since the last barrier
+    # per-resource precedence frontiers (−1 = untouched)
+    acct_read: Dict[bytes, int] = {}
+    acct_write: Dict[bytes, int] = {}
+    acct_delta: Dict[bytes, int] = {}
+    slot_touch: Dict[tuple, int] = {}
+
+    def close_batches() -> None:
+        for b in open_batches:
+            plan.steps.append(Step("batch", b))
+            plan.max_width = max(plan.max_width, len(b))
+        open_batches.clear()
+        acct_read.clear()
+        acct_write.clear()
+        acct_delta.clear()
+        slot_touch.clear()
+
+    for i, stx in enumerate(txs):
+        pred = _classify(stx, senders[i], beneficiary, created,
+                         code_hash_of, learner)
+        if pred is None:
+            close_batches()
+            plan.steps.append(Step(RESIDUE, [i]))
+            plan.n_residue += 1
+            continue
+        plan.predicted[i] = pred
+        if pred.kind == FAST:
+            plan.n_fast += 1
+        else:
+            plan.n_call += 1
+        floor = -1
+        for a in pred.acct_r:
+            floor = max(floor, acct_write.get(a, -1),
+                        acct_delta.get(a, -1))
+        for a in pred.acct_w:
+            floor = max(floor, acct_read.get(a, -1),
+                        acct_write.get(a, -1), acct_delta.get(a, -1))
+        for a in pred.acct_d:
+            floor = max(floor, acct_read.get(a, -1),
+                        acct_write.get(a, -1))
+        for s in pred.slots:
+            floor = max(floor, slot_touch.get(s, -1))
+        batch = floor + 1
+        if batch > 0:
+            plan.conflicted += 1
+        while len(open_batches) <= batch:
+            open_batches.append([])
+        open_batches[batch].append(i)
+        for a in pred.acct_r:
+            acct_read[a] = max(acct_read.get(a, -1), batch)
+        for a in pred.acct_w:
+            acct_write[a] = max(acct_write.get(a, -1), batch)
+        for a in pred.acct_d:
+            acct_delta[a] = max(acct_delta.get(a, -1), batch)
+        for s in pred.slots:
+            slot_touch[s] = max(slot_touch.get(s, -1), batch)
+    close_batches()
+
+    EXEC_GAUGES["planned_blocks"] += 1
+    EXEC_GAUGES["fast_txs"] += plan.n_fast
+    EXEC_GAUGES["call_txs"] += plan.n_call
+    EXEC_GAUGES["residue_txs"] += plan.n_residue
+    EXEC_GAUGES["batches"] += sum(
+        1 for s in plan.steps if s.kind == "batch"
+    )
+    if plan.max_width > EXEC_GAUGES["max_batch_width"]:
+        EXEC_GAUGES["max_batch_width"] = plan.max_width
+    return plan
+
+
+def footprint_ok(pred: Predicted, reads: Dict[str, set],
+                 written: Dict[str, set]) -> bool:
+    """Post-hoc misprediction check: everything the tx ACTUALLY read
+    or wrote must lie inside its predicted footprint. ⊆, not ==: an
+    over-prediction only costs parallelism, never correctness."""
+    return (
+        reads[ON_ACCOUNT] <= pred.acct_r
+        and reads[ON_ADDRESS] <= pred.acct_r
+        and written[ON_ACCOUNT] <= (pred.acct_w | pred.acct_d)
+        and written[ON_ADDRESS] <= pred.acct_d
+        and reads[ON_STORAGE] <= pred.slots
+        and written[ON_STORAGE] <= pred.slots
+        and reads[ON_CODE] <= pred.code_r
+        and not written[ON_CODE]
+    )

@@ -88,6 +88,10 @@ KNOWN_SITES = frozenset({
     # names so the cost model can join bytes to seconds without a map
     "seal.pack", "seal.alias_gather", "seal.dispatch_build",
     "seal.upload", "seal.rootcheck", "seal.journal",
+    # execute-stage sites (ISSUE 14 conflict-aware scheduler): the
+    # vectorized fast-path batches vs the per-tx EVM residue, so
+    # ``bench --diff`` attributes execute-phase movement by site
+    "exec.batch", "exec.residue",
     # sharded multi-device paths (parallel/)
     "shard.dispatch", "shard.gather", "shard.keccak", "shard.verify",
     # raw keccak ops (ops/)

@@ -63,6 +63,17 @@ SEAL_SUBPHASES = (
     SEAL_ROOTCHECK, SEAL_JOURNAL,
 )
 
+# execute sub-phases (ISSUE 14): the window.build span decomposes into
+# the sender-recovery sweep (cache-fronted; the prefetch thread should
+# have made it a no-op) and block execution proper. Same contract as
+# the seal sub-phases: children of a canonical span, never in the
+# phase_shares denominator, matched by name against the
+# phase_share_ceilings watchdog ("senders"/"execute" entries).
+PHASE_SENDERS = "senders"
+PHASE_EXECUTE = "execute"
+
+EXEC_SUBPHASES = (PHASE_SENDERS, PHASE_EXECUTE)
+
 LIFECYCLE_PHASES = (
     PHASE_ANNOUNCE, PHASE_IMPORT, PHASE_BUILD, PHASE_SEAL,
     PHASE_PACK, PHASE_DISPATCH, PHASE_COLLECT, PHASE_PERSIST, PHASE_SAVE,
@@ -460,7 +471,8 @@ try:
             help="wall seconds per canonical lifecycle phase",
             labels={"phase": p},
         )
-        for p in LIFECYCLE_PHASES + (PHASE_STALL,) + SEAL_SUBPHASES
+        for p in (LIFECYCLE_PHASES + (PHASE_STALL,) + SEAL_SUBPHASES
+                  + EXEC_SUBPHASES)
     }
     _trace.set_phase_observer(PHASE_HISTOGRAMS)
 
@@ -468,13 +480,15 @@ try:
         """{phase: share of total phase wall time} from the cumulative
         latency histograms. The denominator is canonical phases only
         (sub-phases nest inside window.seal / window.collect — adding
-        them would double-count the seal wall); sub-phase shares are
+        them would double-count the seal wall, and the execute
+        sub-phases inside window.build likewise); sub-phase shares are
         still reported, as fractions of that same canonical total, so
-        ``seal.upload`` can be read directly against the ceiling."""
+        ``seal.upload`` or ``execute`` can be read directly against
+        the ceiling."""
         canon = LIFECYCLE_PHASES + (PHASE_STALL,)
         sums = {
             p: PHASE_HISTOGRAMS[p].value["sum"]
-            for p in canon + SEAL_SUBPHASES
+            for p in canon + SEAL_SUBPHASES + EXEC_SUBPHASES
         }
         total = sum(sums[p] for p in canon)
         if total <= 0:

@@ -738,8 +738,9 @@ class Watchdog:
 
     def _phase_sums(self) -> dict:
         """Raw cumulative {phase: wall seconds} from the phase latency
-        histograms (canonical phases + seal sub-phases)."""
+        histograms (canonical phases + seal/execute sub-phases)."""
         from khipu_tpu.observability.recorder import (
+            EXEC_SUBPHASES,
             LIFECYCLE_PHASES,
             PHASE_HISTOGRAMS,
             PHASE_STALL,
@@ -748,7 +749,8 @@ class Watchdog:
 
         return {
             p: PHASE_HISTOGRAMS[p].value["sum"]
-            for p in LIFECYCLE_PHASES + (PHASE_STALL,) + SEAL_SUBPHASES
+            for p in (LIFECYCLE_PHASES + (PHASE_STALL,) + SEAL_SUBPHASES
+                      + EXEC_SUBPHASES)
             if p in PHASE_HISTOGRAMS
         }
 

@@ -419,4 +419,10 @@ class ServiceBoard:
                 self._cluster.close()
             except Exception:
                 pass
+        try:
+            from khipu_tpu.ledger.ledger import shutdown_exec_pool
+
+            shutdown_exec_pool()
+        except Exception:
+            pass
         self.storages.stop()

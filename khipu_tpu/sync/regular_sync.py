@@ -717,6 +717,7 @@ class _NullStats:
     """ReplayDriver stats sink for single-block imports."""
 
     blocks = txs = gas = parallel_txs = conflicts = 0
+    fast_path_txs = residue_txs = mispredictions = 0
 
     def __setattr__(self, k, v):  # stats increments land here harmlessly
         object.__setattr__(self, k, v)

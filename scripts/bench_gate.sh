@@ -8,17 +8,18 @@
 # Usage:
 #   scripts/bench_gate.sh [BASELINE.json] [extra bench.py args...]
 #
-# Defaults: BENCH_r08.json (the newest captured baseline — the first
-# one captured with the off-driver seal stage + adaptive commit, so
-# its blocks/s carries the demolished seal wall) and the thresholds
-# baked into bench.py, with two overrides:
-#   * bytes ratio pinned at 1.05x (r08 was captured by the same
+# Defaults: BENCH_r09.json (the newest captured baseline — the first
+# one captured with the conflict-aware scheduler + vectorized fast
+# path + pipelined sender recovery, so its blocks/s carries the
+# demolished execute wall: 62.52 b/s parallel vs r08's 30.84, and it
+# adds the conflict-storm + mixed-contract fixtures) and the
+# thresholds baked into bench.py, with two overrides:
+#   * bytes ratio pinned at 1.05x (r09 was captured by the same
 #     sub-phase-instrumented code the gate runs — device bytes/block
 #     should reproduce within noise, not the legacy 1.25x slack);
-#   * blocks ratio TIGHTENED to 0.8 (the default 0.5 dates from the
-#     seal-wall era when run-to-run variance was dominated by one
-#     35 s phase; post-demolition runs reproduce far tighter, and a
-#     0.5 gate would wave through a 2x regression).
+#   * blocks ratio kept TIGHT at 0.8 (r09 beats r08 on both
+#     pre-existing fixtures, so the post-seal-wall variance argument
+#     still holds; a 0.5 gate would wave through a 2x regression).
 # Override per-run:
 #   scripts/bench_gate.sh BENCH_r07.json --min-blocks-ratio=0.5
 # (a later arg wins: bench.py takes the last value of a repeated flag)
@@ -26,7 +27,7 @@ set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
-BASELINE="${1:-BENCH_r08.json}"
+BASELINE="${1:-BENCH_r09.json}"
 shift || true
 
 if [ ! -f "$BASELINE" ]; then

@@ -460,6 +460,86 @@ class TestCrashRecovery:
         assert board.blockchain.best_block_number == 4
 
 
+# ------------------------------ die inside the vectorized fast path
+
+
+class TestExecuteBatchDeath:
+    """``ledger.batch`` fires per scatter row of the vectorized fast
+    path — ON THE DRIVER THREAD, mid-block, with the batch's world
+    half-scattered. The torn world is memory-only: nothing of the
+    dying block is durable, so recovery rolls back to the last
+    committed window and a serial resume lands bit-exact."""
+
+    def _sched_cfg(self, window=2, depth=2):
+        # the scheduled path needs parallel_tx (the module _cfg runs
+        # serial so the collector seams fire deterministically)
+        return dataclasses.replace(
+            CFG,
+            sync=SyncConfig(
+                parallel_tx=True,
+                commit_window_blocks=window,
+                pipeline_depth=depth,
+                degrade_on_collector_death=False,
+                collector_join_timeout=5.0,
+                adaptive_commit=False,
+            ),
+        )
+
+    @pytest.fixture(scope="class")
+    def wide_chain(self):
+        """12 blocks x 2 DISJOINT transfers: every block takes the
+        scheduled fast path (single-tx blocks dispatch sequential and
+        would never reach the ``ledger.batch`` seam)."""
+        builder = ChainBuilder(
+            Blockchain(Storages(), CFG), CFG, GenesisSpec(alloc=ALLOC)
+        )
+        blocks = []
+        nonces = [0, 0, 0, 0]
+        for n in range(N_BLOCKS):
+            a, b = n % 2, 2 + n % 2  # disjoint sender pair
+            txs = []
+            for i, tag in ((a, 0xBEEF0000), (b, 0xFEED0000)):
+                to = (tag + n).to_bytes(4, "big").rjust(20, b"\x00")
+                txs.append(_tx(i, nonces[i], to, 100 + n))
+                nonces[i] += 1
+            blocks.append(builder.add_block(txs, coinbase=MINER))
+        return blocks
+
+    def test_die_mid_batch_recover_serial_resume_bit_exact(
+        self, wide_chain
+    ):
+        cfg = self._sched_cfg()
+        bc = _fresh(cfg)
+        # 2 scatter rows per block: after=6 kills the driver on block
+        # 4's FIRST row — sender 1 already debited, recipient not yet
+        # credited, window [3..4] un-sealed
+        plan = FaultPlan(
+            seed=11, rules=[FaultRule("ledger.batch", "die", after=6,
+                                      times=1)]
+        )
+        with active(plan):
+            # the fault fires in foreground execute, so the death
+            # surfaces directly (NOT CollectorDied — the collector is
+            # an innocent bystander the driver tears down on the way)
+            with pytest.raises(InjectedDeath):
+                ReplayDriver(bc, cfg).replay(wide_chain)
+        assert [s for (s, _, _, _) in plan.fired] == ["ledger.batch"]
+        # nothing of the torn block is durable
+        assert bc.best_block_number < 4
+
+        report = ReplayDriver(bc, cfg).recover()
+        assert report.best_after == bc.best_block_number
+        assert bc.storages.window_journal.pending() == []
+
+        # resume on the SERIAL path: recovery must not depend on the
+        # scheduler that was running when the process died
+        resume_cfg = _cfg(window=1, depth=1)
+        ReplayDriver(bc, resume_cfg).replay(
+            wide_chain[bc.best_block_number:]
+        )
+        _assert_same_chain(bc, _clean_reference(wide_chain))
+
+
 # ----------------------------------------------- graceful degradation
 
 

@@ -181,7 +181,8 @@ class TestPhaseShares:
         of the same canonical total, so seal.upload reads directly
         against a ceiling."""
         canon = recorder.LIFECYCLE_PHASES + (recorder.PHASE_STALL,)
-        sums = {p: 0.0 for p in canon + recorder.SEAL_SUBPHASES}
+        sums = {p: 0.0 for p in canon + recorder.SEAL_SUBPHASES
+                + recorder.EXEC_SUBPHASES}
         sums[recorder.PHASE_SEAL] = 6.0
         sums[recorder.PHASE_COLLECT] = 4.0
         sums["seal.upload"] = 5.0
@@ -201,6 +202,7 @@ class TestPhaseShares:
         monkeypatch.setattr(
             recorder, "PHASE_HISTOGRAMS",
             {p: _FakeHist(0.0)
-             for p in canon + recorder.SEAL_SUBPHASES},
+             for p in canon + recorder.SEAL_SUBPHASES
+             + recorder.EXEC_SUBPHASES},
         )
         assert recorder.phase_shares() == {}
