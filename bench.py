@@ -2020,12 +2020,253 @@ def bench_rebalance(smoke=False, deadline_s=120.0):
     )
 
 
+def bench_reorg(smoke=False, deadline_s=120.0):
+    """``bench.py --reorg``: the fork-battle fixture — a node serving
+    balance reads through a ReadView while a heavier branch displaces
+    its tip. Two rounds: (1) the switch is KILLED mid-adopt at a
+    ``reorg.*`` chaos seam and recovered in-process off the journaled
+    intent (emits ``reorg_recover_seconds``); (2) a clean switch with
+    a block filter attached (emits ``reorg_switch_blocks_per_sec``).
+    The poller must never observe a balance outside the two legal
+    chain states (old tip / fork point) — a torn read exits 1. Smoke
+    additionally pins the ``khipu_reorg_*`` families to exactly one
+    TYPE line each and trips the ``reorg_storm`` watchdog kind.
+    Runs under a HARD deadline: a wedged switch exits 1, not hangs."""
+    import dataclasses
+    import threading
+
+    from khipu_tpu.base.crypto.secp256k1 import (
+        privkey_to_pubkey,
+        pubkey_to_address,
+    )
+    from khipu_tpu.chaos import FaultPlan, FaultRule, InjectedDeath, active
+    from khipu_tpu.config import SyncConfig, TelemetryConfig, fixture_config
+    from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+    from khipu_tpu.domain.transaction import Transaction, sign_transaction
+    from khipu_tpu.jsonrpc.filters import FilterManager
+    from khipu_tpu.observability.registry import REGISTRY
+    from khipu_tpu.observability.telemetry import Watchdog
+    from khipu_tpu.serving.readview import ReadView
+    from khipu_tpu.storage.storages import Storages
+    from khipu_tpu.sync.chain_builder import ChainBuilder
+    from khipu_tpu.sync.journal import recover
+    from khipu_tpu.sync.reorg import ReorgManager
+    from khipu_tpu.sync.replay import ReplayDriver, ReplayStats
+    from khipu_tpu.txpool import PendingTransactionsPool
+
+    cfg = dataclasses.replace(
+        fixture_config(chain_id=1),
+        sync=SyncConfig(commit_window_blocks=1, parallel_tx=False),
+    )
+    keys = [(i + 1).to_bytes(32, "big") for i in range(4)]
+    addrs = [pubkey_to_address(privkey_to_pubkey(k)) for k in keys]
+    genesis = GenesisSpec(alloc={a: 1000 * 10**18 for a in addrs})
+    miner_a, miner_b = b"\xaa" * 20, b"\xbb" * 20
+
+    n_base = 8 if smoke else 24
+    diverge = n_base - 3  # 3 orphaned blocks, 5 adopted
+    n_fork = n_base + 2
+
+    def build(n, diverged_suffix):
+        builder = ChainBuilder(Blockchain(Storages(), cfg), cfg, genesis)
+        blocks, nonces = [], [0, 0, 0, 0]
+        for k in range(n):
+            i = k % 4
+            dv = diverged_suffix and k >= diverge
+            blocks.append(builder.add_block(
+                [sign_transaction(
+                    Transaction(nonces[i], 10**9, 21_000,
+                                addrs[(i + 1) % 4],
+                                100 + k + (1000 if dv else 0)),
+                    keys[i], chain_id=1,
+                )],
+                coinbase=miner_b if dv else miner_a,
+                timestamp=10 * (k + 1),
+            ))
+            nonces[i] += 1
+        return builder.blockchain, blocks
+
+    base_bc, base = build(n_base, False)
+    fork_bc, fork = build(n_fork, True)
+
+    def fresh_node():
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(genesis)
+        driver = ReplayDriver(bc, cfg)
+        stats = ReplayStats()
+        for b in base:
+            driver._execute_and_insert(b, stats)
+        return bc, driver
+
+    def bal(bc, number):
+        h = bc.get_header_by_number(number)
+        acct = bc.get_account(miner_a, h.state_root)
+        return 0 if acct is None else acct.balance
+
+    old_val = bal(base_bc, n_base)
+    anc_val = bal(base_bc, diverge)  # == new-chain value (fork suffix
+    legal = {old_val, anc_val}       # is miner_b's)
+    result = {}
+
+    def drive():
+        # ---- round 1: killed mid-adopt, recovered off the journal
+        bc, driver = fresh_node()
+        pool = PendingTransactionsPool()
+        view = ReadView(bc)
+        mgr = ReorgManager(bc, cfg, driver=driver, txpool=pool,
+                           read_view=view)
+        stop = threading.Event()
+        violations = []
+
+        def poll():
+            while not stop.is_set():
+                try:
+                    _n, acct = view.get_account(miner_a)
+                    v = 0 if acct is None else acct.balance
+                    if v not in legal:
+                        violations.append(v)
+                except Exception as e:  # a reader crash IS a violation
+                    violations.append(repr(e))
+                    return
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        try:
+            plan = FaultPlan(seed=42, rules=[
+                FaultRule("reorg.adopt", "die", times=1, after=2)
+            ])
+            died = False
+            try:
+                with active(plan):
+                    mgr.switch(diverge, fork[diverge:])
+            except InjectedDeath:
+                died = True
+            assert died, "chaos seam reorg.adopt never fired"
+            t0 = time.perf_counter()
+            report = recover(bc, config=cfg, txpool=pool)
+            result["recover_s"] = time.perf_counter() - t0
+            assert report.reorgs_completed == 1, report.actions
+        finally:
+            stop.set()
+            poller.join(timeout=10)
+        assert not violations, violations[:5]
+        ref = fork_bc.get_header_by_number(n_fork)
+        assert bc.storages.app_state.best_block_number == n_fork
+        assert bc.get_header_by_number(n_fork).state_root \
+            == ref.state_root, "recovered tip diverges from fresh replay"
+        assert bc.storages.window_journal.pending() == []
+        adopted_txh = {
+            tx.hash for b in fork[diverge:] for tx in b.body.transactions
+        }
+        for b in base[diverge:]:
+            for tx in b.body.transactions:
+                assert (tx.hash in adopted_txh
+                        or pool.get(tx.hash) is not None), (
+                    "orphaned tx neither re-mined nor pool-resident"
+                )
+
+        # ---- round 2: clean switch, block filter riding the listener
+        bc2, driver2 = fresh_node()
+        pool2 = PendingTransactionsPool()
+        mgr2 = ReorgManager(bc2, cfg, driver=driver2, txpool=pool2)
+        fm = FilterManager(bc2)
+        fid = fm.new_block_filter()
+        fm.changes(fid)  # advance the cursor to the old tip
+        mgr2.add_listener(fm.note_reorg)
+        t0 = time.perf_counter()
+        done = mgr2.switch(diverge, fork[diverge:])
+        result["switch_s"] = time.perf_counter() - t0
+        result["adopted"] = done
+        result["recycled"] = mgr2.recycled_txs
+        assert fm.changes(fid) == [b.hash for b in fork[diverge:]], (
+            "block filter missed the adopted branch"
+        )
+        result["mgr"] = mgr2
+
+    worker = threading.Thread(target=drive, daemon=True)
+    worker.start()
+    worker.join(timeout=deadline_s)
+    if worker.is_alive() or "switch_s" not in result:
+        print(
+            f"bench_reorg: FAILED — switch/recover did not complete "
+            f"within {deadline_s}s",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+    if smoke:
+        # deterministic reorg_storm trip (injected clock + source),
+        # then pin the khipu_reorg_* families to exactly one TYPE line
+        # each and the storm kind in the same exposition
+        count, clock = [0], [100.0]
+        dog = Watchdog(
+            config=TelemetryConfig(
+                enabled=True, reorg_storm_count=3,
+                reorg_storm_window_s=60.0,
+            ),
+            pipeline={}, clock=lambda: clock[0],
+            reorg=lambda: count[0],
+        )
+        dog.check_once()
+        tripped = []
+        for _ in range(3):
+            count[0] += 1
+            clock[0] += 5.0
+            tripped = dog.check_once()
+        assert "reorg_storm" in tripped, tripped
+        text = REGISTRY.prometheus_text()
+        for fam, kind in (
+            ("khipu_reorg_total", "counter"),
+            ("khipu_reorg_refused_total", "counter"),
+            ("khipu_reorg_depth", "gauge"),
+            ("khipu_reorg_orphaned_blocks_total", "counter"),
+            ("khipu_reorg_recycled_txs_total", "counter"),
+        ):
+            n = text.count(f"# TYPE {fam} {kind}")
+            assert n == 1, f"{fam} TYPE lines: {n}"
+        assert 'khipu_watchdog_trips_total{kind="reorg_storm"} 1' \
+            in text, "reorg_storm trip missing from exposition"
+        emit(
+            "reorg_smoke", result["adopted"], "blocks",
+            recover_s=round(result["recover_s"], 4),
+            recycled_txs=result["recycled"],
+            reorg_families_ok=True,
+            storm_trip_ok=True,
+        )
+        return
+
+    emit(
+        "reorg_switch_blocks_per_sec",
+        round(result["adopted"] / result["switch_s"], 1)
+        if result["switch_s"] > 0 else 0.0,
+        "blocks/s",
+        depth=n_base - diverge,
+        adopted=result["adopted"],
+        recycled_txs=result["recycled"],
+        note="journaled two-phase switch incl. fence, intent fsync, "
+             "rollback, re-execution of the adopted branch and orphan "
+             "recycling",
+    )
+    emit(
+        "reorg_recover_seconds",
+        round(result["recover_s"], 4),
+        "seconds",
+        killed_at="reorg.adopt",
+        outcome="rolled_forward",
+        note="in-process journal recovery after a mid-adopt death, "
+             "serving reads throughout (zero torn reads tolerated)",
+    )
+
+
 def main() -> None:
     if "--serve" in sys.argv:
         bench_serve(smoke="--smoke" in sys.argv)
         return
     if "--rebalance" in sys.argv:
         bench_rebalance(smoke="--smoke" in sys.argv)
+        return
+    if "--reorg" in sys.argv:
+        bench_reorg(smoke="--smoke" in sys.argv)
         return
     compare_path = None
     diff_path = None

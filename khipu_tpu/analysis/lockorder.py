@@ -28,6 +28,16 @@ Approach (intra-package, flow-insensitive where it must be):
    through calls) and report: SCC cycles in the order graph (error),
    same-non-reentrant-lock re-acquisition (error), and blocking calls
    — direct or via a callee — under any held lock (warning).
+4. Shared-mutable-state locksets (the Eraser half proper):
+   ``Thread(target=...)`` callables and the functions that spawn them
+   are thread entry points ("roots"); a per-root *always-held*
+   intersection fixpoint gives the locks provably held whenever each
+   function runs under that root.  A ``self.attr`` write's lockset is
+   the always-held set plus the locks held at the write site; an
+   attribute written from >= 2 distinct roots whose write locksets
+   share no common lock is a latent write-write race (warning).
+   ``__init__`` bodies are exempt — construction happens before
+   publication.
 
 Identity is per (class, attr), not per instance: two instances of the
 same class share an order node, which over-approximates (safe) and
@@ -78,6 +88,10 @@ class FuncInfo:
         self.acquires: List[Tuple[LockId, Tuple[LockId, ...], int]] = []
         self.calls: List[Tuple[tuple, Tuple[LockId, ...], int]] = []
         self.blocking: List[Tuple[str, str, Tuple[LockId, ...], int]] = []
+        # self.attr writes: (class, attr, held-set, line)
+        self.writes: List[Tuple[str, str, Tuple[LockId, ...], int]] = []
+        # Thread(target=...) callable refs spawned by this function
+        self.thread_targets: List[Tuple[tuple, int]] = []
 
 
 class ModuleScan:
@@ -86,6 +100,7 @@ class ModuleScan:
         self.path = mod.path
         self.threading_aliases: Set[str] = set()
         self.threading_names: Set[str] = set()
+        self.thread_ctor_names: Set[str] = set()  # from-imported Thread
         self.time_aliases: Set[str] = set()
         self.time_sleep_names: Set[str] = set()
         self.jax_aliases: Set[str] = set()
@@ -142,6 +157,8 @@ class _Scanner:
                     if node.module == "threading":
                         if a.name in LOCK_CTORS:
                             s.threading_names.add(bind)
+                        elif a.name in ("Thread", "Timer"):
+                            s.thread_ctor_names.add(bind)
                     elif node.module == "time" and a.name == "sleep":
                         s.time_sleep_names.add(bind)
                     s.object_imports[bind] = (node.module, a.name)
@@ -301,6 +318,8 @@ class _Scanner:
             self._block(stmt.body, held + newly, info, cls, qualname,
                         local_locks)
             return held
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._record_writes(stmt, held, info, cls)
         if isinstance(stmt, ast.Assign) and isinstance(
             stmt.value, ast.Call
         ):
@@ -324,12 +343,60 @@ class _Scanner:
             self._block(h.body, held, info, cls, qualname, local_locks)
         return held
 
+    def _record_writes(self, stmt, held, info, cls) -> None:
+        """``self.attr = ...`` targets, plain or tuple-unpacked.
+        Subscript targets (container mutation) and writes to the lock
+        attributes themselves are out of scope."""
+        if cls is None:
+            return
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        flat: List[ast.AST] = []
+        for t in targets:
+            flat.extend(t.elts if isinstance(t, (ast.Tuple, ast.List))
+                        else [t])
+        for t in flat:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and t.attr not in self.s.class_locks.get(cls, ())
+            ):
+                info.writes.append((cls, t.attr, tuple(held), t.lineno))
+
+    def _thread_ctor(self, call: ast.Call) -> str:
+        """'Thread'/'Timer' when ``call`` constructs one, else ''."""
+        f = call.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id in self.s.threading_aliases
+            and f.attr in ("Thread", "Timer")
+        ):
+            return f.attr
+        if isinstance(f, ast.Name) and f.id in self.s.thread_ctor_names:
+            return f.id
+        return ""
+
     def _scan_calls(self, expr, held, info, cls, qualname, local_locks):
         held = list(held)
         calls = [n for n in ast.walk(expr) if isinstance(n, ast.Call)]
         calls.sort(key=lambda c: (c.lineno, c.col_offset))
         for call in calls:
             f = call.func
+            ctor = self._thread_ctor(call)
+            if ctor:
+                # the target runs on ANOTHER thread — record it as a
+                # thread entry point, not a synchronous call edge
+                refs = [kw.value for kw in call.keywords
+                        if kw.arg in ("target", "function")]
+                if ctor == "Timer" and len(call.args) >= 2:
+                    refs.append(call.args[1])
+                for r in refs:
+                    tref = self._callable_arg_ref(r, cls)
+                    if tref is not None:
+                        info.thread_targets.append((tref, call.lineno))
+                continue
             if isinstance(f, ast.Attribute):
                 lock = self._lock_of(f.value, cls, qualname, local_locks)
                 if lock is not None and f.attr == "acquire":
@@ -612,7 +679,74 @@ class LockOrderAnalysis:
             "may_acquire": may_acquire,
             "may_block": may_block,
             "resolved_calls": resolved_calls,
+            "races": self._shared_state_races(resolved_calls),
         }
+
+    def _shared_state_races(
+        self, resolved_calls
+    ) -> List[Tuple[str, int, str, str, List[str]]]:
+        """Eraser-style write locksets per thread entry point.
+
+        Roots are resolved ``Thread(target=...)`` callables plus their
+        spawners (spawner and target run concurrently by definition).
+        For each root, a decreasing fixpoint computes the locks ALWAYS
+        held when each reachable function runs; a write's lockset is
+        that set plus the locks held at the write site.  An attribute
+        written from >= 2 distinct roots with an empty intersection
+        across all its write locksets is a latent write-write race.
+        ``__init__`` writes are construction, not sharing — exempt.
+        """
+        roots: Set[Tuple[str, str]] = set()
+        for key, fi in self.functions.items():
+            if fi.thread_targets:
+                roots.add(key)
+            for ref, _line in fi.thread_targets:
+                tgt = self.resolve(key, ref)
+                if tgt is not None and tgt in self.functions:
+                    roots.add(tgt)
+
+        # (path, class, attr) -> accumulated evidence
+        state: Dict[Tuple[str, str, str], dict] = {}
+        for root in sorted(roots):
+            always: Dict[Tuple[str, str], frozenset] = {
+                root: frozenset()
+            }
+            work = [root]
+            while work:
+                f = work.pop()
+                for callee, held, _line in resolved_calls[f]:
+                    cand = always[f] | frozenset(held)
+                    cur = always.get(callee)
+                    new = cand if cur is None else (cur & cand)
+                    if cur is None or new != cur:
+                        always[callee] = new
+                        work.append(callee)
+            for f, base in always.items():
+                if f[1].split(".")[-1] == "__init__":
+                    continue
+                for cls, attr, held, line in self.functions[f].writes:
+                    lockset = base | frozenset(held)
+                    rec = state.setdefault(
+                        (f[0], cls, attr),
+                        {"roots": set(), "common": None,
+                         "where": (f[0], line)},
+                    )
+                    rec["roots"].add(root)
+                    rec["common"] = (
+                        lockset if rec["common"] is None
+                        else rec["common"] & lockset
+                    )
+                    rec["where"] = min(rec["where"], (f[0], line))
+
+        races = []
+        for (path, cls, attr), rec in sorted(state.items()):
+            if len(rec["roots"]) < 2 or rec["common"]:
+                continue
+            races.append((
+                rec["where"][0], rec["where"][1], cls, attr,
+                sorted(r[1] for r in rec["roots"]),
+            ))
+        return races
 
     # ---------------------------------------------------------- results
 
@@ -703,6 +837,22 @@ class LockOrderAnalysis:
                     ),
                     context=key[1],
                 )
+
+        # shared attrs written from >= 2 thread roots, no common lock
+        for path, line, cls, attr, rootnames in data["races"]:
+            yield Finding(
+                rule=RULE_ID,
+                severity=SEVERITY_WARNING,
+                path=path,
+                line=line,
+                message=(
+                    f"shared attribute {cls}.{attr} is written from "
+                    f"{len(rootnames)} thread entry points "
+                    f"({', '.join(rootnames)}) with no common lock in "
+                    f"its write lockset"
+                ),
+                context=f"{cls}.{attr}",
+            )
 
     def cycles(self) -> List[List[LockId]]:
         """SCCs with >= 2 locks — the acceptance-gate surface."""

@@ -350,6 +350,12 @@ class TelemetryConfig:
     # don't judge shares until this much canonical phase time has been
     # observed (a 0.1 s startup blip trivially exceeds any ceiling)
     phase_share_min_total_s: float = 5.0
+    # reorg_storm trips (edge-triggered) when this many chain switches
+    # land within the window — healthy tip-following reorgs are rare
+    # singletons; a burst means competing miners, an unstable peer set,
+    # or an eclipse attempt feeding us alternating branches
+    reorg_storm_count: int = 3
+    reorg_storm_window_s: float = 60.0
     # gauge families echoed into khipu_cluster_report per shard
     key_gauges: tuple = (
         "khipu_pipeline_in_flight",

@@ -61,6 +61,7 @@ class EthService:
         read_view=None,
         serving=None,
         telemetry=None,
+        reorg_manager=None,
     ):
         self.blockchain = blockchain
         self.config = config
@@ -100,6 +101,10 @@ class EthService:
         self._filter_manager = FilterManager(
             blockchain, ttl=config.serving.filter_ttl
         )
+        # chain switches retract delivered logs (`removed: true`) and
+        # rewind filter cursors to the fork point (sync/reorg.py)
+        if reorg_manager is not None:
+            reorg_manager.add_listener(self._filter_manager.note_reorg)
         # chain-head + store-cache samples for the unified registry
         # (replace-by-key: the newest service owns the slot)
         try:
@@ -476,7 +481,7 @@ class EthService:
             "transactionHash": data(hit.tx_hash),
             "transactionIndex": qty(hit.tx_index),
             "logIndex": qty(hit.log_index),
-            "removed": False,
+            "removed": bool(getattr(hit, "removed", False)),
         }
 
     def _check_log_range(self, query) -> None:

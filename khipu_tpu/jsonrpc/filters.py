@@ -38,6 +38,10 @@ class LogHit:
     tx_hash: bytes
     tx_index: int
     log_index: int
+    # True on entries retracting a previously-delivered log whose
+    # block a reorg orphaned (eth_getFilterChanges parity: clients
+    # un-apply, then receive the adopted branch's logs fresh)
+    removed: bool = False
 
 
 def _matches(log, query: LogQuery) -> bool:
@@ -142,8 +146,12 @@ class FilterManager:
         self._ids = itertools.count(1)
         self._filters = {}
         self._last_poll = {}  # fid -> monotonic time of last touch
+        # fid -> queued ``removed: true`` retractions a reorg produced
+        # for logs this filter already delivered (drained by changes())
+        self._removed = {}
         self._lock = threading.Lock()
         self.evictions = 0
+        self.reorgs_seen = 0
         try:
             from khipu_tpu.observability.registry import REGISTRY
 
@@ -175,6 +183,7 @@ class FilterManager:
         ]:
             self._filters.pop(fid, None)
             self._last_poll.pop(fid, None)
+            self._removed.pop(fid, None)
             self.evictions += 1
 
     def snapshot(self) -> dict:
@@ -230,7 +239,39 @@ class FilterManager:
     def uninstall(self, fid: int) -> bool:
         with self._lock:
             self._last_poll.pop(fid, None)
+            self._removed.pop(fid, None)
             return self._filters.pop(fid, None) is not None
+
+    def note_reorg(self, ancestor_number: int,
+                   removed_hits: Sequence[LogHit]) -> None:
+        """A reorg orphaned every block above ``ancestor_number``
+        (ReorgManager listener — sync/reorg.py). Per installed filter:
+        queue ``removed: true`` retractions for logs it already
+        delivered, then rewind its cursor to the fork point so the
+        adopted branch's results deliver fresh on the next poll.
+        Filters whose cursor never crossed the fork are untouched."""
+        with self._lock:
+            self.reorgs_seen += 1
+            for fid, entry in list(self._filters.items()):
+                kind, query, last_seen = entry
+                if kind == "pending" or last_seen <= ancestor_number:
+                    continue  # never delivered anything above the fork
+                if kind == "blocks":
+                    self._filters[fid] = (kind, query, ancestor_number)
+                    continue
+                mine = [
+                    h for h in removed_hits
+                    if query.from_block <= h.block_number <= last_seen
+                    and (query.to_block is None
+                         or h.block_number <= query.to_block)
+                    and _matches(h, query)
+                ]
+                if mine:
+                    self._removed.setdefault(fid, []).extend(mine)
+                self._filters[fid] = (
+                    kind, query,
+                    max(ancestor_number, query.from_block - 1),
+                )
 
     # one poll never scans more than this many blocks; the cursor
     # advances by at most the same amount, so a huge catch-up range is
@@ -287,5 +328,8 @@ class FilterManager:
                     if window.from_block <= window.to_block
                     else []
                 )
+                # retractions first: a client un-applies the orphaned
+                # logs before applying the adopted branch's
+                out = self._removed.pop(fid, []) + out
             self._filters[fid] = (kind, query, horizon)
             return out

@@ -73,7 +73,7 @@ __all__ = [
 # until tripped) so the khipu_watchdog_trips_total family exists from
 # the first scrape, which is what the bench smoke pin keys on
 WATCHDOG_KINDS = ("stage_stall", "journal_runaway", "scrape_dead",
-                  "rebalance_stuck", "phase_anomaly")
+                  "rebalance_stuck", "phase_anomaly", "reorg_storm")
 
 # collector-pipeline stages the watchdog reads from PIPELINE_GAUGES
 # (sync/replay.py: stage_<name>_depth / stage_<name>_busy_s)
@@ -606,7 +606,8 @@ class Watchdog:
                  telemetry: Optional[ClusterTelemetry] = None,
                  tracer=None, registry: MetricsRegistry = REGISTRY,
                  clock: Callable[[], float] = time.monotonic,
-                 rebalance: Optional[Callable[[], tuple]] = None):
+                 rebalance: Optional[Callable[[], tuple]] = None,
+                 reorg: Optional[Callable[[], int]] = None):
         self.config = config or TelemetryConfig(enabled=True)
         self.registry = registry
         self._pipeline = pipeline  # dict-like stage gauges (or lazy)
@@ -621,6 +622,8 @@ class Watchdog:
         self._dead: set = set()
         self._rebalance_src = rebalance
         self._reb = {"prog": None, "since": 0.0, "tripped": False}
+        self._reorg_src = reorg
+        self._rg = {"samples": deque(), "tripped": False}
         self._phase_over: Dict[str, bool] = {}
         self._phase_share_src = None  # injectable: () -> (shares, total_s)
         # baseline snapshot: shares are judged over phase time accrued
@@ -715,6 +718,33 @@ class Watchdog:
                     stalled_s=round(now - st["since"], 3),
                 )
                 tripped.append("rebalance_stuck")
+        if self._reorg_src is not None:
+            try:
+                count = self._reorg_src()
+            except Exception:
+                count = None
+            if count is not None:
+                st = self._rg
+                win = getattr(self.config, "reorg_storm_window_s", 60.0)
+                thresh = getattr(self.config, "reorg_storm_count", 3)
+                st["samples"].append((now, count))
+                while (len(st["samples"]) > 1
+                       and now - st["samples"][0][0] > win):
+                    st["samples"].popleft()
+                rate = count - st["samples"][0][1]
+                if rate >= thresh:
+                    # edge-triggered: one trip per storm, re-armed
+                    # when the windowed rate falls back under the
+                    # threshold (competing miners settling down)
+                    if not st["tripped"]:
+                        st["tripped"] = True
+                        self._trip(
+                            "reorg_storm", reorgs=rate,
+                            window_s=win,
+                        )
+                        tripped.append("reorg_storm")
+                else:
+                    st["tripped"] = False
         ceilings = getattr(self.config, "phase_share_ceilings", ()) or ()
         if ceilings:
             shares, total = self._phase_shares()
@@ -798,6 +828,14 @@ class Watchdog:
         open, keys streamed)`` (Rebalancer.watch_source). Attachable
         after construction: the board builds the rebalancer lazily."""
         self._rebalance_src = source
+
+    def attach_reorg(self, source: Callable[[], int]) -> None:
+        """Hook a reorg-rate source — ``() -> cumulative switch
+        count`` (ReorgManager.watch_source). ``reorg_storm`` trips
+        when ``reorg_storm_count`` switches land within
+        ``reorg_storm_window_s``; attachable after construction (the
+        board builds regular sync lazily)."""
+        self._reorg_src = source
 
     # ----------------------------------------------------------- thread
 

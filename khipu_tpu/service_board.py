@@ -34,15 +34,16 @@ class ServiceBoard:
             self.blockchain.load_genesis(genesis or GenesisSpec())
         # crash-recovery startup pass (sync/journal.py): settle any
         # window-commit intents a previous process death left pending —
-        # repair complete windows, roll partial ones back. None when
-        # the journal is clean (the overwhelmingly common boot).
+        # repair complete windows, roll partial ones back, complete or
+        # abandon torn chain switches. None when the journal is clean
+        # (the overwhelmingly common boot).
         self.recovery_report = None
         if config.sync.commit_journal:
             if self.storages.window_journal.pending():
                 from khipu_tpu.sync.journal import recover
 
                 self.recovery_report = recover(
-                    self.blockchain, log=print
+                    self.blockchain, log=print, config=config
                 )
         self.tx_pool = PendingTransactionsPool()
         self.ommers_pool = OmmersPool()
@@ -112,6 +113,10 @@ class ServiceBoard:
             ),
             serving=self._serving,
             telemetry=self._telemetry,
+            reorg_manager=(
+                self._regular_sync.reorg
+                if self._regular_sync is not None else None
+            ),
         )
         extra = ()
         keystore_dir = key_dir or (
@@ -374,6 +379,18 @@ class ServiceBoard:
         self._regular_sync = RegularSyncService(
             self.blockchain, self.config, self._peer_manager, **kwargs
         )
+        if self._watchdog is not None:
+            # reorg-rate storm detector samples the switch counter
+            self._watchdog.attach_reorg(
+                self._regular_sync.reorg.watch_source
+            )
+        if self._rpc_server is not None:
+            # RPC came up first: hang the filter manager's reorg hook
+            # on the freshly-built switch path
+            svc = getattr(self._rpc_server, "service", None)
+            fm = getattr(svc, "_filter_manager", None)
+            if fm is not None:
+                self._regular_sync.reorg.add_listener(fm.note_reorg)
         return self._regular_sync
 
     def start_fast_sync(self, **kwargs):

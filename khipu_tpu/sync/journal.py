@@ -29,20 +29,39 @@ orphaned trie nodes are harmless — content-addressed, unreferenced,
 reclaimed by the compactor). Once one window rolls back every later
 pending window rolls back too: its parent chain is gone.
 
+A chain REORG (sync/reorg.py) journals a third record shape in the
+same seq stream:
+
+* REORG-INTENT — ``[b"R", seq, ancestor_number, ancestor_hash,
+  [old_hash, ...], [adopted_hash, ...], [orphan_tx_rlp, ...]]`` under
+  ``b"J" + seq``, with the adopted branch's FULL block RLP staged
+  under ``b"RB" + seq + number`` and flushed BEFORE the intent.
+  Staging first makes the switch atomic: once the intent is durable,
+  recovery can always re-execute the adopted branch from the (still
+  durable) ancestor state, so a kill anywhere inside the switch
+  resolves to exactly the old chain (abandon: nothing was removed
+  yet) or exactly the new one (roll forward: strip everything above
+  the ancestor, re-execute the staged blocks). The orphan txs — mined
+  on the losing branch only — ride in the record because the rollback
+  removes their bodies: an in-process recovery handed a txpool can
+  still recycle them after a mid-switch death.
+
 Crash points and their outcomes are enumerated in docs/recovery.md;
-tests/test_chaos.py provokes them with the chaos harness.
+tests/test_chaos.py and tests/test_reorg.py provoke them with the
+chaos harness.
 """
 
 from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Union
 
 from khipu_tpu.base.rlp import rlp_decode, rlp_encode
 
 _INTENT_PREFIX = b"J"
 _COMMIT_PREFIX = b"C"
+_REORG_BLOCK_PREFIX = b"RB"  # staged adopted-branch block RLP
 _HEAD_KEY = b"head"  # next seq to assign
 _TAIL_KEY = b"tail"  # lowest seq not yet pruned
 
@@ -55,6 +74,11 @@ def _int_bytes(n: int) -> bytes:
     return int(n).to_bytes(8, "big").lstrip(b"\x00") or b"\x00"
 
 
+def _block_key(seq: int, number: int) -> bytes:
+    return (_REORG_BLOCK_PREFIX + int(seq).to_bytes(8, "big")
+            + int(number).to_bytes(8, "big"))
+
+
 @dataclass
 class IntentRecord:
     seq: int
@@ -62,6 +86,37 @@ class IntentRecord:
     hi: int
     parent_root: bytes
     roots: List[bytes]  # expected header state roots, lo..hi
+
+
+@dataclass
+class ReorgRecord:
+    seq: int
+    ancestor_number: int
+    ancestor_hash: bytes
+    old_hashes: List[bytes]  # ancestor+1 .. old tip (the chain we leave)
+    adopted_hashes: List[bytes]  # ancestor+1 .. new tip (staged branch)
+    # txs mined ONLY on the losing branch (their bodies do not survive
+    # the rollback): recovery recycles these into a provided txpool
+    orphan_tx_rlp: List[bytes] = field(default_factory=list)
+
+    @property
+    def old_top(self) -> int:
+        return self.ancestor_number + len(self.old_hashes)
+
+    @property
+    def new_top(self) -> int:
+        return self.ancestor_number + len(self.adopted_hashes)
+
+    def orphan_txs(self) -> list:
+        from khipu_tpu.domain.transaction import SignedTransaction
+
+        out = []
+        for raw in self.orphan_tx_rlp:
+            try:
+                out.append(SignedTransaction.decode(raw))
+            except Exception:
+                pass  # a torn tx row loses one orphan, not the switch
+        return out
 
 
 class WindowJournal:
@@ -121,6 +176,61 @@ class WindowJournal:
             self._flush()
         return seq
 
+    def log_reorg_intent(self, ancestor_number: int, ancestor_hash: bytes,
+                         old_hashes: List[bytes], adopted_blocks,
+                         orphan_txs=()) -> int:
+        """Stage the adopted branch + fsync the reorg intent; durable
+        BEFORE the switch removes anything. Staging goes first (own
+        flush barrier): an intent that promises a branch recovery
+        cannot read would be a torn switch with no winning side. A
+        crash between the two leaves orphan staged rows under a seq
+        the head never covered — bounded garbage, ignored by the scan
+        and overwritten when the seq is eventually assigned."""
+        if len(adopted_blocks) == 0:
+            raise ValueError("a reorg adopts at least one block")
+        first = adopted_blocks[0].number
+        if first != ancestor_number + 1:
+            raise ValueError(
+                f"adopted branch starts at #{first}, expected "
+                f"#{ancestor_number + 1}"
+            )
+        with self._lock:
+            seq = self._get_int(_HEAD_KEY)
+            for b in adopted_blocks:
+                self.source.put(_block_key(seq, b.number), b.encode())
+            self._flush()
+            self.source.put(
+                _seq_key(_INTENT_PREFIX, seq),
+                rlp_encode([
+                    b"R", _int_bytes(seq), _int_bytes(ancestor_number),
+                    bytes(ancestor_hash),
+                    [bytes(h) for h in old_hashes],
+                    [bytes(b.hash) for b in adopted_blocks],
+                    [stx.encode() for stx in orphan_txs],
+                ]),
+            )
+            self.source.put(_HEAD_KEY, int(seq + 1).to_bytes(8, "big"))
+            self._flush()
+        return seq
+
+    def staged_blocks(self, rec: "ReorgRecord"):
+        """Decode the adopted branch staged for ``rec`` (roll-forward
+        input). None if any staged row is missing — impossible after a
+        durable intent (staging flushes first) but recovery treats it
+        as roll-back-only rather than crash."""
+        from khipu_tpu.domain.block import Block
+
+        out = []
+        with self._lock:
+            for i in range(len(rec.adopted_hashes)):
+                raw = self.source.get(
+                    _block_key(rec.seq, rec.ancestor_number + 1 + i)
+                )
+                if raw is None:
+                    return None
+                out.append(Block.decode(raw))
+        return out
+
     def log_commit(self, seq: int) -> None:
         """The window's blocks are saved and best advanced — or
         recovery settled the intent (repair OR rollback); either way
@@ -131,10 +241,10 @@ class WindowJournal:
 
     # ------------------------------------------------------------ reading
 
-    def pending(self) -> List[IntentRecord]:
-        """Intents without a commit mark, ascending — the windows a
-        crash may have left half-persisted."""
-        out: List[IntentRecord] = []
+    def pending(self) -> List[Union[IntentRecord, "ReorgRecord"]]:
+        """Intents without a commit mark, ascending — the windows (or
+        chain switches) a crash may have left half-persisted."""
+        out: List[Union[IntentRecord, ReorgRecord]] = []
         with self._lock:
             tail = self._get_int(_TAIL_KEY)
             head = self._get_int(_HEAD_KEY)
@@ -148,17 +258,30 @@ class WindowJournal:
         return out
 
     @staticmethod
-    def _decode(raw: bytes) -> IntentRecord:
-        tag, seq, lo, hi, parent_root, roots = rlp_decode(raw)
-        if tag != b"I":
-            raise ValueError(f"bad journal record tag {tag!r}")
-        return IntentRecord(
-            seq=int.from_bytes(seq, "big"),
-            lo=int.from_bytes(lo, "big"),
-            hi=int.from_bytes(hi, "big"),
-            parent_root=parent_root,
-            roots=list(roots),
-        )
+    def _decode(raw: bytes) -> Union[IntentRecord, "ReorgRecord"]:
+        fields = rlp_decode(raw)
+        tag = fields[0]
+        if tag == b"I":
+            _, seq, lo, hi, parent_root, roots = fields
+            return IntentRecord(
+                seq=int.from_bytes(seq, "big"),
+                lo=int.from_bytes(lo, "big"),
+                hi=int.from_bytes(hi, "big"),
+                parent_root=parent_root,
+                roots=list(roots),
+            )
+        if tag == b"R":
+            _, seq, anc_n, anc_h, old, adopted = fields[:6]
+            orphans = list(fields[6]) if len(fields) > 6 else []
+            return ReorgRecord(
+                seq=int.from_bytes(seq, "big"),
+                ancestor_number=int.from_bytes(anc_n, "big"),
+                ancestor_hash=anc_h,
+                old_hashes=list(old),
+                adopted_hashes=list(adopted),
+                orphan_tx_rlp=orphans,
+            )
+        raise ValueError(f"bad journal record tag {tag!r}")
 
     def prune(self) -> int:
         """Drop the settled prefix (intent+commit pairs below the first
@@ -171,10 +294,22 @@ class WindowJournal:
             seq = tail
             while seq < head:
                 ik = _seq_key(_INTENT_PREFIX, seq)
-                if (self.source.get(ik) is not None
+                raw = self.source.get(ik)
+                if (raw is not None
                         and not self.source.get(
                             _seq_key(_COMMIT_PREFIX, seq))):
                     break  # first pending — stop
+                if raw is not None:
+                    try:
+                        rec = self._decode(raw)
+                    except ValueError:
+                        rec = None
+                    if isinstance(rec, ReorgRecord):
+                        # a settled switch's staged branch goes with it
+                        for i in range(len(rec.adopted_hashes)):
+                            self.source.remove(_block_key(
+                                seq, rec.ancestor_number + 1 + i
+                            ))
                 self.source.remove(ik)
                 self.source.remove(_seq_key(_COMMIT_PREFIX, seq))
                 removed += 1
@@ -201,6 +336,8 @@ class RecoveryReport:
     blocks_removed: int = 0
     missing_nodes: int = 0  # state-walk misses across failed verifies
     corrupt_nodes: int = 0  # content-address mismatches found
+    reorgs_completed: int = 0  # torn switches rolled FORWARD to new tip
+    reorgs_abandoned: int = 0  # switches killed before any removal
     best_before: int = 0
     best_after: int = 0
     actions: List[str] = field(default_factory=list)
@@ -210,13 +347,24 @@ class RecoveryReport:
         return self.scanned == 0
 
 
-def recover(blockchain, log: Optional[Callable[[str], None]] = None
-            ) -> RecoveryReport:
+def recover(blockchain, log: Optional[Callable[[str], None]] = None,
+            config=None, txpool=None) -> RecoveryReport:
     """The startup pass (ReplayDriver.recover / ServiceBoard.__init__):
     settle every pending intent — repair complete windows, roll back
-    partial ones, leave ``best_block_number`` on the last window whose
-    state fully verifies. Idempotent: a crash DURING recovery re-enters
-    the same scan."""
+    partial ones, complete or abandon torn chain switches, leave
+    ``best_block_number`` on the last block whose state fully verifies.
+    Idempotent: a crash DURING recovery re-enters the same scan.
+
+    ``config`` (a KhipuConfig) enables reorg roll-forward: a torn
+    switch re-executes its staged branch from the ancestor state.
+    Without one (legacy callers) the node settles at the ancestor —
+    still a consistent chain prefix, finished on the next start.
+
+    ``txpool`` (in-process recovery, e.g. ReorgManager's mid-switch
+    failure path): orphan txs staged in a settled reorg intent are
+    recycled into it through the pool's replacement rules. Boot-time
+    recovery passes None — a restarted process has no pool to
+    protect."""
     storages = blockchain.storages
     # the device mirror is volatile: recovery verification must see
     # exactly what a real restart would see — host-durable state only.
@@ -232,6 +380,19 @@ def recover(blockchain, log: Optional[Callable[[str], None]] = None
     rollback_floor: Optional[int] = None  # first rolled-back lo
 
     for rec in pending:
+        if isinstance(rec, ReorgRecord):
+            outcome = _settle_reorg(
+                blockchain, rec, journal, report, config, rollback_floor,
+                txpool=txpool,
+            )
+            journal.log_commit(rec.seq)
+            if outcome == "rolled_forward":
+                # the chain was rebuilt through the adopted branch:
+                # later pending window intents (journaled by the
+                # crashed windowed adoption) verify against the
+                # re-executed blocks
+                rollback_floor = None
+            continue
         verified = False
         if rollback_floor is None:
             verified = _verify_window(blockchain, rec, report)
@@ -323,6 +484,178 @@ def _rollback_window(blockchain, rec: IntentRecord) -> int:
             h = s.block_numbers.hash_of(n)
             if h is not None:
                 s.block_numbers.remove(h)
+        s.block_header_storage.source.remove(n)
+        s.block_body_storage.source.remove(n)
+        s.receipts_storage.source.remove(n)
+        s.total_difficulty_storage.source.remove(n)
+    return removed
+
+
+def _recycle_orphans(txpool, rec: ReorgRecord, report) -> None:
+    """Re-enter the losing branch's orphan txs through the pool's
+    standard replacement rules (a pooled higher-bid same-slot tx keeps
+    its place)."""
+    if txpool is None or not rec.orphan_tx_rlp:
+        return
+    recycled = 0
+    for stx in rec.orphan_txs():
+        if stx.sender is None:
+            continue
+        try:
+            if txpool.add(stx):
+                recycled += 1
+        except ValueError:
+            pass
+    if recycled:
+        report.actions.append(
+            f"reorg at #{rec.ancestor_number}: {recycled} orphaned "
+            f"txs recycled into the pool"
+        )
+
+
+def _settle_reorg(blockchain, rec: ReorgRecord, journal, report,
+                  config, rollback_floor, txpool=None) -> str:
+    """Resolve one pending reorg intent to a whole chain.
+
+    ABANDON when the old chain is untouched (the kill hit after the
+    intent fsync but before the rollback removed anything): the node
+    is already at exactly the old chain — nothing to do.
+
+    ROLL FORWARD otherwise: the switch is torn (old blocks partially
+    removed, adopted blocks partially saved, or any mix). Strip
+    everything above the ancestor and re-execute the staged branch
+    from the durable ancestor state — the node lands at exactly the
+    new chain. Re-execution goes through the same validated import
+    path as live sync, so the recovered chain is bit-exact vs a fresh
+    replay of the winning branch."""
+    s = blockchain.storages
+    anc = rec.ancestor_number
+
+    # intactness is judged by block PRESENCE, not the best pointer:
+    # the switch drops best to the ancestor before it removes anything
+    # (serving safety — sync/reorg.py _rollback), so a kill there
+    # leaves best low with the old chain untouched. Restore best.
+    intact = s.app_state.best_block_number in (rec.old_top, anc)
+    if intact:
+        for i, h in enumerate(rec.old_hashes):
+            n = anc + 1 + i
+            if (s.block_numbers.hash_of(n) != h
+                    or s.block_header_storage.get(n) is None
+                    or s.block_body_storage.get(n) is None):
+                intact = False
+                break
+    if intact:
+        s.app_state.best_block_number = rec.old_top
+        report.reorgs_abandoned += 1
+        report.actions.append(
+            f"reorg at #{anc} abandoned: old chain intact through "
+            f"#{rec.old_top}"
+        )
+        return "abandoned"
+
+    # mirror-image fast path: the kill hit AFTER adoption finished
+    # (pre-finalize) — if the new chain is fully present and its tip
+    # state verifies end-to-end, completing is just the commit mark
+    if _new_chain_complete(blockchain, rec, report):
+        report.reorgs_completed += 1
+        report.actions.append(
+            f"reorg at #{anc} completed in place: adopted chain "
+            f"verified through #{rec.new_top}"
+        )
+        _recycle_orphans(txpool, rec, report)
+        return "rolled_forward"
+
+    top = max(rec.old_top, rec.new_top,
+              s.app_state.best_block_number,
+              max(0, s.best_block_number))
+    removed = _remove_above(blockchain, anc, top)
+    s.app_state.best_block_number = anc
+    report.blocks_removed += removed
+
+    blocks = journal.staged_blocks(rec)
+    # roll-forward needs a config (gas schedule, chain id) and an
+    # ancestor whose state a prior window rollback did not take out;
+    # failing either, the ancestor prefix is the consistent stop
+    if (config is None or blocks is None
+            or (rollback_floor is not None and rollback_floor <= anc)):
+        report.rolled_back += 1
+        report.actions.append(
+            f"reorg at #{anc} rolled back to ancestor "
+            f"({removed} block records removed; no roll-forward "
+            f"{'config' if config is None else 'state'})"
+        )
+        # at the ancestor NEITHER branch's txs are mined
+        _recycle_orphans(txpool, rec, report)
+        return "rolled_back"
+
+    from khipu_tpu.sync.replay import ReplayDriver, ReplayStats
+
+    driver = ReplayDriver(blockchain, config)
+    stats = ReplayStats()
+    for b in blocks:
+        driver._execute_and_insert(b, stats)
+    report.reorgs_completed += 1
+    report.actions.append(
+        f"reorg at #{anc} rolled forward: {removed} torn block records "
+        f"removed, {len(blocks)} adopted blocks re-executed to "
+        f"#{rec.new_top}"
+    )
+    _recycle_orphans(txpool, rec, report)
+    return "rolled_forward"
+
+
+def _new_chain_complete(blockchain, rec: ReorgRecord, report) -> bool:
+    """Every adopted block at its number with full records, best at
+    the new tip, and the tip state reachable with clean content
+    addresses — same bar _verify_window holds torn windows to."""
+    from khipu_tpu.storage.compactor import verify_reachable
+
+    s = blockchain.storages
+    if s.app_state.best_block_number != rec.new_top:
+        return False
+    for i, h in enumerate(rec.adopted_hashes):
+        n = rec.ancestor_number + 1 + i
+        if (s.block_numbers.hash_of(n) != h
+                or s.block_header_storage.get(n) is None
+                or s.block_body_storage.get(n) is None
+                or s.receipts_storage.get(n) is None
+                or s.total_difficulty_storage.get(n) is None):
+            return False
+    tip = blockchain.get_header_by_number(rec.new_top)
+    walk = verify_reachable(
+        s.account_node_storage, s.storage_node_storage,
+        s.evmcode_storage, tip.state_root, verify_hashes=True,
+    )
+    report.missing_nodes += walk.missing
+    report.corrupt_nodes += walk.corrupt
+    return walk.missing == 0 and walk.corrupt == 0
+
+
+def _remove_above(blockchain, ancestor: int, top: int) -> int:
+    """Raw by-number removal of every block record in
+    (ancestor, top] — old-chain remnants and partially-adopted blocks
+    alike. NOT Blockchain.remove_block: a torn switch may have either
+    half of any record missing."""
+    from khipu_tpu.domain.block import BlockBody
+
+    s = blockchain.storages
+    removed = 0
+    for n in range(ancestor + 1, top + 1):
+        header_raw = s.block_header_storage.get(n)
+        body_raw = s.block_body_storage.get(n)
+        if (header_raw is None and body_raw is None
+                and s.receipts_storage.get(n) is None):
+            continue
+        removed += 1
+        if body_raw is not None:
+            try:
+                for tx in BlockBody.decode(body_raw).transactions:
+                    s.transaction_storage.source.remove(tx.hash)
+            except Exception:
+                pass  # a torn body still gets its by-number records cut
+        h = s.block_numbers.hash_of(n)
+        if h is not None:
+            s.block_numbers.remove(h)
         s.block_header_storage.source.remove(n)
         s.block_body_storage.source.remove(n)
         s.receipts_storage.source.remove(n)

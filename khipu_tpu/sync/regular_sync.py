@@ -49,6 +49,7 @@ from khipu_tpu.network.messages import (
 from khipu_tpu.network.peer import Peer, PeerError, PeerManager
 from khipu_tpu.observability.trace import span
 from khipu_tpu.sync.replay import CollectorDied, ReplayDriver
+from khipu_tpu.sync.reorg import ReorgManager, ReorgTooDeep
 from khipu_tpu.trie.mpt import MPTNodeMissingException
 from khipu_tpu.validators.roots import ommers_hash, transactions_root
 
@@ -83,6 +84,13 @@ class RegularSyncService:
         self._driver = ReplayDriver(
             blockchain, config, device_commit=device_commit,
             read_view=read_view,
+        )
+        # journaled atomic chain switch (sync/reorg.py): TD-winning
+        # side branches route through it instead of the old
+        # unjournaled block-at-a-time rewind
+        self.reorg = ReorgManager(
+            blockchain, config, driver=self._driver, txpool=txpool,
+            read_view=read_view, log=log,
         )
         # serializes chain mutation between the pull loop and the
         # NewBlock push handler (which runs on peer reader threads)
@@ -233,13 +241,21 @@ class RegularSyncService:
         return branch
 
     def _rollback_to(self, ancestor_number: int) -> None:
-        """Remove our blocks above the common ancestor (reorg adoption;
-        called only once the replacement blocks are fully fetched)."""
+        """Remove our blocks above the common ancestor. Unjournaled
+        primitive — live reorgs go through ReorgManager.switch; this
+        stays for callers that rewind a chain they fully own. The walk
+        must REACH the ancestor: a missing header mid-walk means best
+        points above a hole, and silently moving best there (the old
+        behavior) would canonize the gap."""
         n = self.blockchain.best_block_number
         while n > ancestor_number:
             header = self.blockchain.get_header_by_number(n)
             if header is None:
-                break
+                raise SyncAborted(
+                    f"rollback found no header at #{n} (walking "
+                    f"{self.blockchain.best_block_number} -> "
+                    f"{ancestor_number}): chain store has a hole"
+                )
             self.blockchain.remove_block(header.hash)
             n -= 1
         self.blockchain.storages.app_state.best_block_number = ancestor_number
@@ -376,11 +392,26 @@ class RegularSyncService:
                 anc = self.blockchain.get_header_by_number(ancestor_number)
                 if anc is None or anc.hash != headers[0].parent_hash:
                     return 0  # chain changed under us; resolve next round
-                self._rollback_to(ancestor_number)
+                # journaled atomic switch: fence -> intent -> rollback
+                # -> adopt (windowed for long branches) -> finalize
+                # (sync/reorg.py). Depth refusal escalates as PeerError:
+                # a peer whose branch forks below the unconfirmed ring
+                # gets demoted, we keep our chain.
+                try:
+                    done = self.reorg.switch(
+                        ancestor_number, blocks,
+                        import_fn=lambda b: self._import_healing(peer, b),
+                    )
+                except ReorgTooDeep as e:
+                    raise PeerError(str(e))
+                self.reorgs += 1
+                imported += done
+                self.imported += done
                 self.log(
-                    f"reorg: rolled back to #{ancestor_number}, adopting "
-                    f"{len(headers)} peer blocks"
+                    f"reorg: switched at #{ancestor_number}, adopted "
+                    f"{done} peer blocks"
                 )
+                blocks = []  # fully consumed by the switch
             else:
                 # drop blocks a concurrent push already covered; if the
                 # remainder no longer attaches, defer to the next round
@@ -400,7 +431,7 @@ class RegularSyncService:
             # instead of block-at-a-time import; anything it didn't
             # take falls through to the healing per-block path below
             window = self.config.sync.commit_window_blocks
-            if window > 1 and not is_reorg and len(blocks) >= window:
+            if window > 1 and len(blocks) >= window:
                 # the adaptive backend probe it can reach is one-shot,
                 # process-cached (~ms), and must finish before any
                 # window commits anyway — holding _import_lock across
@@ -417,21 +448,7 @@ class RegularSyncService:
                     self.imported += done
                     blocks = blocks[done:]
             for block in blocks:
-                with span("import", block=block.header.number,
-                          txs=len(block.body.transactions)):
-                    for attempt in range(3):
-                        try:
-                            self._driver._execute_and_insert(
-                                block, _NullStats()
-                            )
-                            break
-                        except MPTNodeMissingException as e:
-                            self._heal_missing_node(peer, e.hash)
-                    else:
-                        raise SyncAborted(
-                            f"block {block.header.number} kept failing "
-                            "after heals"
-                        )
+                self._import_healing(peer, block)
                 if self.txpool is not None:
                     self.txpool.remove_mined(block.body.transactions)
                 imported += 1
@@ -442,6 +459,22 @@ class RegularSyncService:
                 f"#{self.blockchain.best_block_number}"
             )
         return imported
+
+    def _import_healing(self, peer: Peer, block: Block) -> None:
+        """Single-block validated import with the missing-node heal
+        loop — the per-block live path, also handed to
+        ReorgManager.switch for per-block branch adoption."""
+        with span("import", block=block.header.number,
+                  txs=len(block.body.transactions)):
+            for attempt in range(3):
+                try:
+                    self._driver._execute_and_insert(block, _NullStats())
+                    return
+                except MPTNodeMissingException as e:
+                    self._heal_missing_node(peer, e.hash)
+            raise SyncAborted(
+                f"block {block.header.number} kept failing after heals"
+            )
 
     def _import_windowed(self, blocks: List[Block]) -> int:
         """Import a fetched batch through the windowed pipeline;

@@ -495,10 +495,11 @@ class ReplayDriver:
     def recover(self):
         """Crash-recovery startup pass (sync/journal.py): settle every
         pending window-commit intent — repair complete windows, roll
-        back partial ones. Returns a RecoveryReport."""
+        back partial ones, complete or abandon torn chain switches.
+        Returns a RecoveryReport."""
         from khipu_tpu.sync.journal import recover
 
-        return recover(self.blockchain, log=self.log)
+        return recover(self.blockchain, log=self.log, config=self.config)
 
     def replay(self, blocks: Iterable[Block]) -> ReplayStats:
         """executeAndInsertBlocks: serial fold with full validation."""

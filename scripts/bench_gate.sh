@@ -46,6 +46,9 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
 echo "== rebalance smoke (a wedged cutover fails the gate) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --rebalance --smoke
 
+echo "== reorg smoke (a torn switch, torn read, or missing khipu_reorg_* family fails the gate) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --reorg --smoke
+
 echo "== bench regression gate (baseline: $BASELINE) =="
 # --diff: on a failure (or any movement past tolerance) print the
 # differential attribution — WHICH phase/sub-phase site moved and by

@@ -301,6 +301,58 @@ class TestRuleFixtures:
         )}, rules=[RULES_BY_ID["KL004"]])
         assert findings == []
 
+    LOCKSET_SRC = (
+        "import threading\n"
+        "class Pump:\n"
+        "    def __init__(self):\n"
+        "        self.lock = threading.Lock()\n"
+        "        self.count = 0\n"
+        "        self.depth = 0\n"
+        "    def _loop(self):\n"
+        "        self.count = self.count + 1\n"
+        "        with self.lock:\n"
+        "            self.depth = 1\n"
+        "    def kick(self):\n"
+        "        t = threading.Thread(target=self._loop)\n"
+        "        t.start()\n"
+        "        self.count = 5\n"
+        "        with self.lock:\n"
+        "            self.depth = 2\n"
+    )
+
+    def test_kl004_lockset_unlocked_shared_write_fires(self, tmp_path):
+        """ISSUE 15: ``count`` is written by the spawned thread AND
+        its spawner with no lock in either write's lockset."""
+        findings = _scan(tmp_path, {"mod.py": self.LOCKSET_SRC},
+                         rules=[RULES_BY_ID["KL004"]])
+        hits = [f for f in findings if "no common lock" in f.message]
+        assert len(hits) == 1
+        assert "Pump.count" in hits[0].message
+        assert hits[0].severity == "warning"
+        assert hits[0].context == "Pump.count"
+
+    def test_kl004_lockset_common_lock_is_clean(self, tmp_path):
+        """``depth`` is written from the same two entry points but
+        both writes hold ``self.lock`` — no finding; ``__init__``
+        writes never count as sharing."""
+        findings = _scan(tmp_path, {"mod.py": self.LOCKSET_SRC},
+                         rules=[RULES_BY_ID["KL004"]])
+        assert not any("depth" in f.message for f in findings)
+
+    def test_kl004_lockset_single_root_is_clean(self, tmp_path):
+        """One thread entry point writing an attr — even unlocked —
+        is not a race by itself."""
+        findings = _scan(tmp_path, {"mod.py": (
+            "import threading\n"
+            "class Solo:\n"
+            "    def __init__(self):\n"
+            "        self.n = 0\n"
+            "        threading.Thread(target=self._loop).start()\n"
+            "    def _loop(self):\n"
+            "        self.n = 1\n"
+        )}, rules=[RULES_BY_ID["KL004"]])
+        assert not any("no common lock" in f.message for f in findings)
+
     def test_kl005_span_outside_with_fires(self, tmp_path):
         findings = _scan(tmp_path, {"mod.py": (
             "def f():\n"
