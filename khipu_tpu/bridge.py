@@ -291,6 +291,58 @@ class BridgeServer:
         nxt = out[-1][0] if out else bytes(cursor)
         return rlp_encode([done, nxt, out])
 
+    def _engine_info(self, request: bytes, context) -> bytes:
+        """Capability negotiation for segment-ship (cluster/rebalance
+        and segment-streamed fast sync): ``rlp([engine, [[topic, seq,
+        size], ...]])``. Non-Kesque engines answer with their name and
+        an empty manifest — the caller falls back to the paged
+        ``StreamNodeData`` path."""
+        storages = self.blockchain.storages
+        engine = getattr(storages, "kesque_engine", None)
+        if engine is None:
+            name = getattr(storages, "engine", "unknown")
+            return rlp_encode([name.encode(), []])
+        manifest = [
+            [topic.encode(), to_minimal_bytes(seq), to_minimal_bytes(size)]
+            for topic, seq, size in engine.list_segments()
+        ]
+        return rlp_encode([b"kesque", manifest])
+
+    def _stream_segments(self, request: bytes, context) -> bytes:
+        """Raw whole-frame segment chunks — the bulk-movement unit.
+        Request ``rlp([topic, seq, offset, max_bytes])``; response
+        ``rlp([done, next_offset, raw])``. Restartable from any offset
+        (frame boundaries are self-describing), serves only the
+        committed prefix, and ships bytes the RECEIVER verifies by
+        content address — a corrupt chunk cannot land under a valid
+        key."""
+        storages = self.blockchain.storages
+        engine = getattr(storages, "kesque_engine", None)
+        if engine is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "segment streaming requires the kesque engine",
+            )
+        try:
+            topic_b, seq_b, off_b, max_b = rlp_decode(request)
+            topic = topic_b.decode()
+            seq = from_bytes(seq_b)
+            offset = from_bytes(off_b)
+            max_bytes = min(from_bytes(max_b) or (1 << 20), 8 << 20)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad: {e}")
+        try:
+            raw, nxt, done = engine.read_chunk(topic, seq, offset,
+                                               max_bytes)
+        except KeyError as e:
+            # compacted away mid-stream: NOT_FOUND tells the puller to
+            # refetch the manifest and restart (idempotent by content
+            # address)
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return rlp_encode([
+            b"\x01" if done else b"", to_minimal_bytes(nxt), raw,
+        ])
+
     def _ping(self, request: bytes, context) -> bytes:
         if request == CLOCK_PROBE:
             # shard wall clock, anchored through the tracer epoch so a
@@ -359,6 +411,10 @@ class BridgeServer:
             "PutNodeData": _guarded("PutNodeData", self._put_node_data),
             "StreamNodeData": _guarded(
                 "StreamNodeData", self._stream_node_data
+            ),
+            "EngineInfo": _guarded("EngineInfo", self._engine_info),
+            "StreamSegments": _guarded(
+                "StreamSegments", self._stream_segments
             ),
             "Ping": _guarded("Ping", self._ping),
             "GetTraceSpans": _guarded(
@@ -497,6 +553,41 @@ class BridgeClient:
             nxt,
             [(h, fault_value("bridge.node.value", v))
              for h, v in pairs],
+        )
+
+    def engine_info(self):
+        """``(engine_name, [(topic, seq, size), ...])`` — the shard's
+        storage engine and (for Kesque) its segment manifest. The
+        rebalancer's capability negotiation: ``name == "kesque"``
+        means the peer can segment-ship."""
+        name, manifest = rlp_decode(self._call("EngineInfo", b""))
+        return (
+            name.decode(),
+            [
+                (topic.decode(), from_bytes(seq), from_bytes(size))
+                for topic, seq, size in manifest
+            ],
+        )
+
+    def stream_segments(self, topic: str, seq: int, offset: int = 0,
+                        max_bytes: int = 1 << 20):
+        """One raw whole-frame chunk of a shard's segment:
+        ``(raw, next_offset, done)``. The caller MUST parse the frames
+        and verify every record by content address before admitting it
+        (the kesque ingest path does — a bit-flip injected through the
+        ``bridge.segment.raw`` corrupt seam must die at the receiver's
+        keccak, never in the store)."""
+        done, nxt, raw = rlp_decode(self._call(
+            "StreamSegments",
+            rlp_encode([
+                topic.encode(), to_minimal_bytes(seq),
+                to_minimal_bytes(offset), to_minimal_bytes(max_bytes),
+            ]),
+        ))
+        return (
+            fault_value("bridge.segment.raw", raw),
+            from_bytes(nxt),
+            bool(done),
         )
 
     def ping(self, payload: bytes = b"ping") -> bytes:

@@ -494,6 +494,21 @@ class ShardedNodeClient:
             lambda ch: ch.stream_node_data(ranges, cursor, count),
         )
 
+    def engine_info(self, endpoint: str):
+        """The shard's storage-engine capability + segment manifest:
+        ``(engine_name, [(topic, seq, size), ...])`` — the rebalance
+        segment-ship negotiation probe."""
+        return self._call(endpoint, lambda ch: ch.engine_info())
+
+    def stream_segments(self, endpoint: str, topic: str, seq: int,
+                        offset: int, max_bytes: int):
+        """One raw segment chunk through the retry/breaker machinery:
+        ``(raw, next_offset, done)``."""
+        return self._call(
+            endpoint,
+            lambda ch: ch.stream_segments(topic, seq, offset, max_bytes),
+        )
+
     def push_nodes(self, endpoint: str, nodes: Mapping[bytes, bytes]) -> int:
         """Rebalance write path: place a verified batch onto a gaining
         owner (server re-verifies by content address before admitting,

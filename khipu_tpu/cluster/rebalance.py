@@ -10,11 +10,15 @@ machine over the epoch-fenced ring (cluster/ring.py):
    exact half-open point ranges whose replica chain changes. Only those
    ranges move: ~1/N of the keyspace for one joining shard, never a
    full reshuffle.
-2. **stream** — for each moved range, pull the owning shard's keys in
-   bounded batches over the ``StreamNodeData`` bridge RPC (cursor-
-   paged), verify every value by content address on receipt, and push
-   it to each *gaining* owner through the same ``put_node_data`` path
-   the PR-4 backfill uses (the server re-verifies before admitting).
+2. **stream** — negotiated by capability (``EngineInfo``): when every
+   endpoint on both ends is Kesque-backed, pull raw whole-frame
+   segment chunks over ``StreamSegments`` (segments are the unit of
+   bulk movement — docs/cluster.md); otherwise pull the owning
+   shard's keys in bounded batches over the paged ``StreamNodeData``
+   RPC. Either way every value is verified by content address on
+   receipt and pushed to each *gaining* owner through the same
+   ``put_node_data`` path the PR-4 backfill uses (the server
+   re-verifies before admitting).
    While the transition is open the client writes to BOTH epochs'
    owners and reads new-then-old, so no read can miss a key mid-move.
 3. **cutover** — only after every moved range reports ``done`` and
@@ -47,15 +51,18 @@ acquires them in reverse.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.native.keccak import keccak256_batch
 from khipu_tpu.chaos import fault_point
 from khipu_tpu.cluster.ring import (
     RING_SIZE,
     RingSnapshot,
     _point,
 )
+from khipu_tpu.observability.profiler import HOST, LEDGER
 from khipu_tpu.observability.trace import span
 
 IDLE = "idle"
@@ -143,6 +150,9 @@ class Rebalancer:
     ):
         self.client = client
         self.batch = max(1, batch)
+        # segment-ship chunk size (both ends kesque-backed): raw
+        # whole-frame bytes per StreamSegments pull
+        self.chunk_bytes = 1 << 20
         self._pressure = pressure
         self.log = log or (lambda s: None)
         self._lock = threading.Lock()
@@ -157,6 +167,7 @@ class Rebalancer:
         self.keys_placed = 0  # (key, gainer) placements that landed
         self.completed = 0
         self.aborts = 0
+        self.segment_chunks = 0  # raw chunks moved by segment-ship
         self.last_moved_fraction = 0.0
         client.attach_rebalancer(self)
         try:
@@ -310,9 +321,133 @@ class Rebalancer:
 
     def _stream(self, plan: List[MovedRange], old: RingSnapshot,
                 new: RingSnapshot) -> int:
-        """Pull every moved range from a current owner and push it to
-        the gaining owners, cursor-paged. Raises on the first batch
-        that cannot be completed — partial movement never cuts over."""
+        """Move every planned range, picking the transport by
+        capability negotiation: when EVERY endpoint on both ends of
+        the plan (losing sources and gaining owners) reports the
+        kesque engine, ship raw verified segments in bulk; otherwise
+        — or if the bulk path fails mid-flight — fall back to the
+        paged ``StreamNodeData`` walk. Both transports are idempotent
+        (content-addressed pushes), so a half-done segment-ship
+        attempt followed by a paged pass still lands exactly the
+        planned keys — a mixed-backend join can only ever commit at
+        the old or the new epoch, never in between."""
+        endpoints = sorted(
+            {ep for r in plan for ep in r.sources}
+            | {ep for r in plan for ep in r.gainers}
+        )
+        if plan and self._all_kesque(endpoints):
+            try:
+                return self._stream_segment_ship(plan, old, new)
+            except RebalanceAborted:
+                raise
+            except Exception as e:
+                self.log(
+                    f"rebalance: segment-ship failed "
+                    f"({type(e).__name__}: {e}); falling back to "
+                    "paged StreamNodeData"
+                )
+        return self._stream_paged(plan, old, new)
+
+    def _all_kesque(self, endpoints: List[str]) -> bool:
+        """Capability probe: True iff every endpoint answers
+        ``EngineInfo`` with the kesque engine. Any probe failure (old
+        peer without the RPC, unreachable shard) means "negotiate
+        down" — never "fail the rebalance"."""
+        probe = getattr(self.client, "engine_info", None)
+        if probe is None:
+            return False
+        for ep in endpoints:
+            try:
+                name, _manifest = probe(ep)
+            except Exception:
+                return False
+            if name != "kesque":
+                return False
+        return True
+
+    def _stream_segment_ship(self, plan: List[MovedRange],
+                             old: RingSnapshot,
+                             new: RingSnapshot) -> int:
+        """The bulk transport: pull raw whole-frame chunks of every
+        source segment, recompute each record's content address (the
+        keccak IS the key — receipt-time verification, same argument
+        as the paged path's check), keep the keys inside the moved
+        ranges, and place them to the gaining owners. No per-key
+        cursor walk on the source: the segment manifest is the whole
+        work list, and a chunk is a single sequential read."""
+        from khipu_tpu.storage.kesque import TAG_NODE, decode_record
+        from khipu_tpu.storage.segment import scan_frames
+
+        by_chain: Dict[Tuple[str, ...], List[Tuple[int, int]]] = {}
+        for r in plan:
+            by_chain.setdefault(r.sources, []).append((r.lo, r.hi))
+        streamed = 0
+        for chain, ranges in sorted(by_chain.items()):
+            source, manifest = self._segment_manifest(chain)
+            for topic, seq, _size in manifest:
+                offset, done = 0, False
+                while not done:
+                    with self._lock:
+                        self._check_abort()
+                    fault_point("rebalance.stream")
+                    t0 = time.perf_counter()
+                    raw, offset, done = self.client.stream_segments(
+                        source, topic, seq, offset, self.chunk_bytes
+                    )
+                    if not raw:
+                        break
+                    frames, end = scan_frames(raw)
+                    if end != len(raw):
+                        # a chunk is whole frames by contract: short
+                        # scan = corruption in flight
+                        raise RebalanceError(
+                            f"corrupt segment chunk from {source} "
+                            f"({topic}/{seq}@{offset})"
+                        )
+                    values = []
+                    for _off, payload in frames:
+                        tag, _k, value = decode_record(payload)
+                        if tag != TAG_NODE or not value:
+                            continue  # only node records move
+                        values.append(value)
+                    pairs = []
+                    # one native batch hash per chunk: the recomputed
+                    # address is both the key and the receipt check
+                    for h, value in zip(keccak256_batch(values), values):
+                        pt = _point(h)
+                        if any(lo <= pt < hi for lo, hi in ranges):
+                            pairs.append((h, value))
+                    self.segment_chunks += 1
+                    LEDGER.record("kesque.ship", HOST, len(raw),
+                                  duration=time.perf_counter() - t0)
+                    if pairs:
+                        streamed += len(pairs)
+                        self.keys_streamed += len(pairs)
+                        self._place(pairs, old, new)
+        return streamed
+
+    def _segment_manifest(self, chain: Tuple[str, ...]):
+        """``(source, [(topic, seq, size), ...])`` from the first
+        chain replica that answers as kesque-backed."""
+        last: Optional[Exception] = None
+        for source in chain:
+            try:
+                name, manifest = self.client.engine_info(source)
+            except Exception as e:
+                last = e
+                continue
+            if name == "kesque":
+                return source, manifest
+        raise RebalanceError(
+            f"no kesque source replica in {chain}: {last}"
+        )
+
+    def _stream_paged(self, plan: List[MovedRange], old: RingSnapshot,
+                      new: RingSnapshot) -> int:
+        """The portable transport: pull every moved range from a
+        current owner cursor-paged and push it to the gaining owners.
+        Raises on the first batch that cannot be completed — partial
+        movement never cuts over."""
         streamed = 0
         # one cursor walk per distinct source chain: each shard is
         # asked once for all the ranges it is losing
@@ -473,6 +608,7 @@ class Rebalancer:
             ),
             "keysStreamed": self.keys_streamed,
             "keysPlaced": self.keys_placed,
+            "segmentChunks": self.segment_chunks,
             "completed": self.completed,
             "aborts": self.aborts,
             "lastMovedFraction": round(self.last_moved_fraction, 6),
@@ -488,6 +624,8 @@ class Rebalancer:
              self.keys_streamed),
             ("khipu_rebalance_keys_placed_total", "counter", {},
              self.keys_placed),
+            ("khipu_rebalance_segment_chunks_total", "counter", {},
+             self.segment_chunks),
             ("khipu_rebalance_completed_total", "counter", {},
              self.completed),
             ("khipu_rebalance_aborts_total", "counter", {},

@@ -170,6 +170,15 @@ class WindowCommitter:
         # _gather_ext that already holds the job's digest array
         self._retired: deque = deque()
 
+        # always-on persist-stage accounting (node bytes + keys landed
+        # in the host store, and the seconds they took): feeds the
+        # ``persist_bytes_per_sec`` extra on EVERY replay metric line
+        # (sync/replay.py ReplayStats -> bench emits) — unlike the
+        # ledger's window.store series, this does not need the ledger
+        # enabled
+        self.persist_bytes = 0
+        self.persist_seconds = 0.0
+
         self._storage_source = _StagedReadThrough(
             storages.storage_node_storage, self._staged,
             self._resolved_global,
@@ -835,13 +844,16 @@ class WindowCommitter:
             # half only; the mirror is volatile and detached there)
             fault_point("collector.spill")
             self.storages.storage_node_storage.update([], storage_nodes)
+            store_bytes = sum(len(e) for e in subbed) + 32 * len(live_phs)
+            store_secs = time.perf_counter() - t_store
+            self.persist_bytes += store_bytes
+            self.persist_seconds += store_secs
             if LEDGER.enabled:
                 # host-side store traffic: classification only (HOST
                 # direction never feeds the device-transfer counters)
                 LEDGER.record(
-                    "window.store", HOST,
-                    sum(len(e) for e in subbed) + 32 * len(live_phs),
-                    duration=time.perf_counter() - t_store,
+                    "window.store", HOST, store_bytes,
+                    duration=store_secs,
                 )
         # only THIS window's codes persist (later windows' roots are
         # still unchecked; their codes stay staged until their collect)

@@ -96,6 +96,10 @@ KNOWN_SITES = frozenset({
     "shard.dispatch", "shard.gather", "shard.keccak", "shard.verify",
     # raw keccak ops (ops/)
     "ops.keccak",
+    # kesque log-structured storage engine (storage/kesque.py): bulk
+    # window-spill appends, segment-streamed snapshot ingest, the
+    # compaction copy phase, and rebalance segment-ship bytes
+    "kesque.append", "kesque.ingest", "kesque.compact", "kesque.ship",
     # bench/metrics self-checks
     "bench.smoke",
 })
@@ -110,6 +114,7 @@ COLLECT_CLASSES = {
     "seal.alias_gather": "mirror-admit",
     "mirror.spill": "store-write",
     "window.store": "store-write",
+    "kesque.append": "store-write",
     "block.save": "block-save",
 }
 

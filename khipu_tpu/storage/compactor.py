@@ -10,7 +10,7 @@ production (where the payoff is reclaiming superseded log records).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
 from khipu_tpu.sync.fast_sync import (
@@ -28,6 +28,11 @@ class CompactionReport:
     code_blobs: int = 0
     missing: int = 0
     corrupt: int = 0  # stored bytes whose keccak != key (verify_hashes)
+    # segment-engine extensions (storage/kesque.py fills these in:
+    # bytes the swap freed, and the post-compaction per-segment
+    # live/garbage split feeding the khipu_kesque_* registry families)
+    reclaimed_bytes: int = 0
+    segment_stats: dict = field(default_factory=dict)
 
     @property
     def total(self) -> int:

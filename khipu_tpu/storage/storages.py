@@ -5,8 +5,10 @@ account/storage/evmcode NodeStorages, header/body/receipts/td block
 storages, blocknum, tx, appState; bestBlockNumber = min(bestBody,
 bestReceipts) :40; swithToWithUnconfirmed:46 / clearUnconfirmed:63 fan
 out to all) and ServiceBoard.scala:99-138 engine selection by
-``db.engine`` — engines: ``memory`` | ``native`` (C++ append-log,
-Kesque role) | ``sqlite`` (embedded-KV alternative, LMDB/RocksDB role).
+``db.engine`` — engines: ``memory`` | ``native`` (C++ append-log) |
+``sqlite`` (embedded-KV alternative, LMDB/RocksDB role) | ``kesque``
+(the paper's log-structured segment engine, storage/kesque.py —
+KesqueDataSource.scala role, with segment streaming and compaction).
 """
 
 from __future__ import annotations
@@ -33,6 +35,9 @@ class Storages:
     def __init__(self, engine: str = "memory", data_dir: Optional[str] = None,
                  unconfirmed_depth: int = 20, cache_size: int = 1 << 20):
         self.engine = engine
+        # set for engine == "kesque" only: the log-structured engine's
+        # compaction/segment-streaming surface (storage/kesque.py)
+        self.kesque_engine = None
         if engine == "memory":
             node_src = lambda topic: MemoryNodeDataSource()
             block_src = lambda topic: MemoryBlockDataSource()
@@ -61,6 +66,15 @@ class Storages:
             node_src = lambda topic: SqliteNodeDataSource(data_dir, topic)
             block_src = lambda topic: SqliteBlockDataSource(data_dir, topic)
             kv_src = lambda topic: SqliteKeyValueDataSource(data_dir, topic)
+        elif engine == "kesque":
+            if data_dir is None:
+                raise ValueError("kesque engine requires data_dir")
+            from khipu_tpu.storage.kesque import KesqueEngine
+
+            self.kesque_engine = KesqueEngine(data_dir)
+            node_src = self.kesque_engine.node_source
+            block_src = self.kesque_engine.block_source
+            kv_src = self.kesque_engine.kv_source
         else:
             raise ValueError(f"unknown db.engine {engine!r}")
 
@@ -169,6 +183,15 @@ class Storages:
                 )
             out.update(bytes(k) for k in keys())
         return sorted(out)
+
+    def storage_repair_report(self):
+        """Open-time storage-layer repairs (the Kesque crash
+        contract's torn-tail scan-back + index rebuilds), as report
+        lines for journal recovery to surface. Empty for engines
+        whose open path performs no repair."""
+        if self.kesque_engine is None:
+            return []
+        return self.kesque_engine.repair_lines()
 
     def _all_sources(self):
         for s in self._node_storages:

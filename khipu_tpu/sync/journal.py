@@ -364,7 +364,21 @@ def recover(blockchain, log: Optional[Callable[[str], None]] = None,
     failure path): orphan txs staged in a settled reorg intent are
     recycled into it through the pool's replacement rules. Boot-time
     recovery passes None — a restarted process has no pool to
-    protect."""
+    protect.
+
+    Torn segment tails (kesque engine, docs/kesque.md): the storage
+    layer's OWN open-time repair runs before this pass ever sees the
+    stores — ``Segment.open`` scans back over any frame torn by a
+    death inside ``kesque.append``/``kesque.roll`` and truncates to
+    the last valid boundary, and a sidecar index that covers the
+    truncated bytes (a ``kesque.index`` death) is discarded for a
+    full rebuild. What recovery sees is therefore a PREFIX of the
+    appended records; ``_verify_window``'s hash-verified reachability
+    walk then classifies any record lost off the tail as ``missing``
+    and rolls the torn window back — the same verdict a torn sqlite
+    write would get. The repairs themselves are surfaced as
+    ``storage:`` action lines via ``storages.storage_repair_report``
+    so the scan-back is visible in recovery output."""
     storages = blockchain.storages
     # the device mirror is volatile: recovery verification must see
     # exactly what a real restart would see — host-durable state only.
@@ -374,6 +388,12 @@ def recover(blockchain, log: Optional[Callable[[str], None]] = None,
         detach()
     journal = storages.window_journal
     report = RecoveryReport(best_before=storages.app_state.best_block_number)
+    # open-time storage repairs (kesque torn-tail scan-back / index
+    # rebuild) happened when the engine opened; put them on the record
+    repairs = getattr(storages, "storage_repair_report", None)
+    if repairs is not None:
+        for line in repairs():
+            report.actions.append(f"storage: {line}")
     pending = journal.pending()
     report.scanned = len(pending)
     emit = log or (lambda s: None)

@@ -106,10 +106,24 @@ class ReplayStats:
     # fraction of the collector's busy time that overlapped driver work
     # (1.0 = collect/save fully hidden behind execution)
     pipeline_occupancy: float = 0.0
+    # persist-stage store traffic (WindowCommitter always-on counters):
+    # node bytes + keys landed in the host store and the seconds the
+    # store writes took — bench.py derives persist_bytes_per_sec from
+    # these on every replay metric line
+    persist_bytes: int = 0
+    persist_store_seconds: float = 0.0
 
     @property
     def blocks_per_s(self) -> float:
         return self.blocks / self.seconds if self.seconds else 0.0
+
+    @property
+    def persist_bytes_per_sec(self) -> float:
+        """Persist-stage store throughput (bytes landed per second of
+        store-write time — the number the Kesque engine moves)."""
+        if self.persist_store_seconds <= 0.0:
+            return 0.0
+        return self.persist_bytes / self.persist_store_seconds
 
     @property
     def fast_path_coverage(self) -> float:
@@ -1026,6 +1040,12 @@ class ReplayDriver:
                         with span("pipeline.stall", kind="epoch-drain"):
                             stalled = drain_pipeline()
                         ph["collect"] += stalled
+                        # bank the retiring committer's persist-stage
+                        # counters before the rebuild drops them
+                        stats.persist_bytes += committer.persist_bytes
+                        stats.persist_store_seconds += (
+                            committer.persist_seconds
+                        )
                         committer = make_committer(prev.state_root)
                         blocks_since_reset = 0
                         # header/body maps: ommers reach back 6 ancestors,
@@ -1066,6 +1086,8 @@ class ReplayDriver:
         # every window is durable: free the last in-flight fused jobs'
         # device buffers (earlier retirees were freed at later seals)
         committer.drain_retired()
+        stats.persist_bytes += committer.persist_bytes
+        stats.persist_store_seconds += committer.persist_seconds
         stats.seconds = time.perf_counter() - t_start
         # overlap fraction: collector busy seconds NOT spent with the
         # driver blocked on it ((C - stall)/C) — 1.0 means collect+save

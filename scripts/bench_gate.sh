@@ -8,18 +8,25 @@
 # Usage:
 #   scripts/bench_gate.sh [BASELINE.json] [extra bench.py args...]
 #
-# Defaults: BENCH_r09.json (the newest captured baseline — the first
-# one captured with the conflict-aware scheduler + vectorized fast
-# path + pipelined sender recovery, so its blocks/s carries the
-# demolished execute wall: 62.52 b/s parallel vs r08's 30.84, and it
-# adds the conflict-storm + mixed-contract fixtures) and the
-# thresholds baked into bench.py, with two overrides:
-#   * bytes ratio pinned at 1.05x (r09 was captured by the same
+# Defaults: BENCH_r10.json (the newest captured baseline — first one
+# with the kesque engine, so every replay line carries
+# persist_bytes_per_sec and the capture includes the three gated
+# ingest metrics). NOTE r10 was captured on a DIFFERENT (slower) host
+# than r09 — an A/B of pre-/post-kesque code on the r10 host showed
+# the r09-era code at 0.50-0.78x of the r09 figures while the kesque
+# branch beat it on every fixture, so the r09->r10 headline drop
+# (62.52 -> 32.84 parallel) is host variance, not a regression.
+# Ratios are only meaningful against a same-host baseline, which is
+# exactly what re-baselining restores. Thresholds, with two overrides:
+#   * bytes ratio pinned at 1.05x (r10 was captured by the same
 #     sub-phase-instrumented code the gate runs — device bytes/block
 #     should reproduce within noise, not the legacy 1.25x slack);
-#   * blocks ratio kept TIGHT at 0.8 (r09 beats r08 on both
-#     pre-existing fixtures, so the post-seal-wall variance argument
-#     still holds; a 0.5 gate would wave through a 2x regression).
+#   * blocks ratio WIDENED 0.8 -> 0.65: measured same-code spreads on
+#     the r10 host are parallel 32.8-49.8, mixed-contract 49.2-75.1,
+#     conflict-storm 119.8-164.5 b/s (clean, idle, identical tree) —
+#     a 0.8 gate flakes on that noise floor. 0.65 still catches any
+#     2x regression; tighten back when captures move to a host with a
+#     tighter noise floor (take best-of-N there first).
 # Override per-run:
 #   scripts/bench_gate.sh BENCH_r07.json --min-blocks-ratio=0.5
 # (a later arg wins: bench.py takes the last value of a repeated flag)
@@ -27,7 +34,7 @@ set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
-BASELINE="${1:-BENCH_r09.json}"
+BASELINE="${1:-BENCH_r10.json}"
 shift || true
 
 if [ ! -f "$BASELINE" ]; then
@@ -49,12 +56,15 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --rebalance --smoke
 echo "== reorg smoke (a torn switch, torn read, or missing khipu_reorg_* family fails the gate) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --reorg --smoke
 
+echo "== ingest smoke (segment ingest < 3x the per-node walk, read amp >= 1.5x, or a missing khipu_kesque_* family fails the gate) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --ingest --smoke
+
 echo "== bench regression gate (baseline: $BASELINE) =="
 # --diff: on a failure (or any movement past tolerance) print the
 # differential attribution — WHICH phase/sub-phase site moved and by
 # how many bytes/block — instead of just the tripped headline ratio
 JAX_PLATFORMS="${JAX_PLATFORMS:-}" python bench.py \
     --compare="$BASELINE" --diff --max-bytes-ratio=1.05 \
-    --min-blocks-ratio=0.8 "$@"
+    --min-blocks-ratio=0.65 "$@"
 
 echo "bench_gate: OK"

@@ -1,0 +1,839 @@
+"""Kesque log-structured engine (khipu_tpu/storage/segment.py,
+storage/kesque.py, sync/fast_sync.py segment ingest, cluster
+segment-ship — docs/kesque.md).
+
+The headline guarantees under test: the frame codec round-trips and a
+torn tail is truncated at EVERY byte boundary of the final frame; the
+sidecar index checkpoint and the rebuild-on-open path agree bit-exact;
+``Storages(engine="kesque")`` replays the transfer AND contract
+fixtures to the identical chain the sqlite engine produces; 120 seeded
+kills across the ``kesque.append`` / ``kesque.roll`` /
+``kesque.index`` seams always recover bit-exact after a restart-style
+reopen; compaction under concurrent readers never serves a wrong byte;
+and a mixed-backend rebalance join negotiates down to the paged
+transport and lands at exactly the old or the new epoch — never
+between."""
+
+import dataclasses
+import os
+import threading
+
+import pytest
+
+from khipu_tpu.base.crypto.keccak import keccak256
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.chaos import FaultPlan, FaultRule, InjectedDeath, active
+from khipu_tpu.config import SyncConfig, fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.storage.compactor import verify_reachable
+from khipu_tpu.storage.kesque import (
+    KesqueEngine,
+    KesqueStore,
+    TAG_NODE,
+    decode_record,
+    encode_del_record,
+    encode_node_record,
+    encode_put_record,
+)
+from khipu_tpu.storage.segment import (
+    FRAME_HEADER,
+    Segment,
+    frame,
+    scan_frames,
+)
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.sync.fast_sync import segment_snapshot_ingest
+from khipu_tpu.sync.journal import recover
+from khipu_tpu.sync.replay import CollectorDied, ReplayDriver
+
+CFG = fixture_config(chain_id=1)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(4)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ETH = 10**18
+MINER = b"\xaa" * 20
+ALLOC = {a: 1000 * ETH for a in ADDRS}
+N_BLOCKS = 12
+
+# contract with storage slots AND deployed runtime code, so fixtures
+# cross all three node stores (same shape as test_fast_sync)
+_RUNTIME = bytes.fromhex("60005460005260206000f3")
+_SSTORES = bytes.fromhex("602a600055600b600155")
+_COPY = bytes(
+    [0x60, len(_RUNTIME), 0x60, len(_SSTORES) + 12, 0x60, 0x00, 0x39,
+     0x60, len(_RUNTIME), 0x60, 0x00, 0xF3]
+)
+INIT = _SSTORES + _COPY + _RUNTIME
+
+
+def _tx(i, nonce, to, value, payload=b"", gas=21_000):
+    return sign_transaction(
+        Transaction(nonce, 10**9, gas, to, value, payload),
+        KEYS[i], chain_id=1,
+    )
+
+
+@pytest.fixture(scope="module")
+def transfer_chain():
+    """The 12-block transfer fixture (test_chaos shape): enough
+    windows for a depth-2 pipeline to be mid-flight when a fault
+    lands."""
+    builder = ChainBuilder(
+        Blockchain(Storages(), CFG), CFG, GenesisSpec(alloc=ALLOC)
+    )
+    blocks = []
+    nonces = [0, 0, 0, 0]
+    for n in range(N_BLOCKS):
+        i = n % len(KEYS)
+        blocks.append(
+            builder.add_block(
+                [_tx(i, nonces[i], ADDRS[(i + 1) % 4], 100 + n)],
+                coinbase=MINER,
+            )
+        )
+        nonces[i] += 1
+    return blocks
+
+
+@pytest.fixture(scope="module")
+def contract_chain():
+    """The contract fixture: a deploy (state + storage + code) plus a
+    transfer, so replay parity covers all three node topics."""
+    builder = ChainBuilder(
+        Blockchain(Storages(), CFG), CFG, GenesisSpec(alloc=ALLOC)
+    )
+    return [
+        builder.add_block(
+            [_tx(0, 0, None, 0, INIT, gas=200_000)], coinbase=MINER
+        ),
+        builder.add_block(
+            [_tx(0, 1, ADDRS[1], 5 * ETH)], coinbase=MINER
+        ),
+    ]
+
+
+def _cfg(window=2, depth=2, degrade=False):
+    return dataclasses.replace(
+        CFG,
+        sync=SyncConfig(
+            parallel_tx=False,
+            commit_window_blocks=window,
+            pipeline_depth=depth,
+            degrade_on_collector_death=degrade,
+            collector_join_timeout=5.0,
+            adaptive_commit=False,
+        ),
+    )
+
+
+def _replay_into(storages, chain, cfg=None):
+    cfg = cfg or _cfg()
+    bc = Blockchain(storages, cfg)
+    bc.load_genesis(GenesisSpec(alloc=ALLOC))
+    ReplayDriver(bc, cfg).replay(chain)
+    return bc
+
+
+def _assert_same_chain(bc, ref, upto):
+    assert bc.best_block_number == ref.best_block_number == upto
+    for n in range(upto + 1):
+        a, b = bc.get_header_by_number(n), ref.get_header_by_number(n)
+        assert a is not None and a.hash == b.hash, f"block {n} diverged"
+
+
+# ------------------------------------------------------ frame codec
+
+
+class TestFrameCodec:
+    def test_frame_roundtrip_various_sizes(self, tmp_path):
+        payloads = [b"", b"x", b"y" * 7, b"z" * 100, b"w" * 5000]
+        blob = b"".join(frame(p) for p in payloads)
+        frames, end = scan_frames(blob)
+        assert [p for _off, p in frames] == payloads
+        assert end == len(blob)
+        # offsets address the frames exactly
+        for off, p in frames:
+            one, _ = scan_frames(blob[off : off + FRAME_HEADER + len(p)])
+            assert one == [(0, p)]
+
+    def test_record_codec_roundtrip(self):
+        assert decode_record(encode_node_record(b"rlp")) == (
+            TAG_NODE, None, b"rlp"
+        )
+        tag, key, value = decode_record(encode_put_record(b"k", b"v"))
+        assert (key, value) == (b"k", b"v") and tag != TAG_NODE
+        tag, key, value = decode_record(encode_del_record(b"gone"))
+        assert key == b"gone" and value == b""
+
+    def test_scan_stops_at_bitflip(self):
+        payloads = [b"a" * 40, b"b" * 40, b"c" * 40]
+        blob = bytearray(b"".join(frame(p) for p in payloads))
+        blob[FRAME_HEADER + 45 + 5] ^= 0xFF  # inside frame 2's payload
+        frames, end = scan_frames(bytes(blob))
+        assert [p for _o, p in frames] == [b"a" * 40]
+        assert end == FRAME_HEADER + 40
+
+    def test_append_many_matches_per_record_append(self, tmp_path):
+        payloads = [b"p%d" % i * (i + 1) for i in range(20)]
+        one = Segment(str(tmp_path / "one.kseg"), 0)
+        locs_one = [one.append(p) for p in payloads]
+        many = Segment(str(tmp_path / "many.kseg"), 0)
+        locs_many = many.append_many(payloads)
+        assert locs_one == locs_many
+        assert one.end == many.end
+        for (off, _rec), p in zip(locs_many, payloads):
+            assert many.read(off) == p
+        one.close(), many.close()
+
+    def test_read_chunk_cuts_on_frame_boundaries(self, tmp_path):
+        seg = Segment(str(tmp_path / "s.kseg"), 0)
+        payloads = [b"r%03d" % i * 20 for i in range(50)]
+        seg.append_many(payloads)
+        got, offset, done = [], 0, False
+        while not done:
+            raw, offset, done = seg.read_chunk(offset, 300)
+            frames, end = scan_frames(raw)
+            assert end == len(raw)  # whole frames only
+            got.extend(p for _o, p in frames)
+        assert got == payloads
+        # a single frame larger than max_bytes still ships whole
+        raw, nxt, done = seg.read_chunk(0, 1)
+        assert scan_frames(raw)[0][0][1] == payloads[0]
+        seg.close()
+
+
+# -------------------------------------------------------- torn tails
+
+
+class TestTornTail:
+    def test_truncation_at_every_byte_boundary_of_final_frame(
+            self, tmp_path):
+        """THE crash-contract sweep: cut the file after every single
+        byte of the final frame (header bytes included) — open must
+        keep exactly the complete leading frames and truncate the
+        rest, every time."""
+        payloads = [b"first" * 10, b"second" * 10, b"final" * 10]
+        seed = Segment(str(tmp_path / "seed.kseg"), 0)
+        locs = seed.append_many(payloads)
+        seed.close()
+        with open(str(tmp_path / "seed.kseg"), "rb") as f:
+            full = f.read()
+        last_off = locs[-1][0]
+        for cut in range(last_off, len(full)):
+            p = str(tmp_path / f"cut{cut}.kseg")
+            with open(p, "wb") as f:
+                f.write(full[:cut])
+            seg, torn = Segment.open(p, 0)
+            assert torn == cut - last_off
+            assert seg.end == last_off
+            assert [pl for _o, pl in seg.scan()] == payloads[:2]
+            seg.unlink()
+        # and the untouched file loses nothing
+        seg, torn = Segment.open(str(tmp_path / "seed.kseg"), 0)
+        assert torn == 0 and [p for _o, p in seg.scan()] == payloads
+        seg.close()
+
+    def test_store_reopen_truncates_torn_tail(self, tmp_path):
+        st = KesqueStore(str(tmp_path), "account", content_addressed=True)
+        data = {keccak256(b"v%d" % i): b"v%d" % i for i in range(30)}
+        st.append_batch([], data)
+        st.stop()
+        # a power cut mid-append: garbage past the committed end
+        seg_dir = os.path.join(str(tmp_path), "kesque", "account")
+        name = sorted(os.listdir(seg_dir))[-2]  # newest .kseg (not .kidx)
+        assert name.endswith(".kseg")
+        with open(os.path.join(seg_dir, name), "ab") as f:
+            f.write(b"\xde\xad\xbe\xef torn tail bytes")
+        st2 = KesqueStore(str(tmp_path), "account", content_addressed=True)
+        assert st2.torn_bytes > 0
+        assert not st2.rebuilt_index  # sidecar still valid post-repair
+        for k, v in data.items():
+            assert st2.get(k) == v
+        st2.stop()
+
+    def test_recovery_report_surfaces_storage_repairs(self, tmp_path):
+        cfg = _cfg(window=1, depth=1)
+        st = Storages(engine="kesque", data_dir=str(tmp_path))
+        bc = Blockchain(st, cfg)
+        bc.load_genesis(GenesisSpec(alloc=ALLOC))
+        st.stop()
+        seg_dir = os.path.join(str(tmp_path), "kesque", "account")
+        seg = [n for n in sorted(os.listdir(seg_dir))
+               if n.endswith(".kseg")][-1]
+        with open(os.path.join(seg_dir, seg), "ab") as f:
+            f.write(b"torn")
+        st2 = Storages(engine="kesque", data_dir=str(tmp_path))
+        bc2 = Blockchain(st2, cfg)
+        report = recover(bc2, config=cfg)
+        assert any(
+            line.startswith("storage:") and "torn segment tail" in line
+            for line in report.actions
+        ), report.actions
+        st2.stop()
+
+
+# ------------------------------------------------- index lifecycle
+
+
+class TestIndexLifecycle:
+    def _data(self, n, tag=0):
+        return {
+            keccak256(b"node-%d-%d" % (tag, i)): b"node-%d-%d" % (tag, i)
+            for i in range(n)
+        }
+
+    def test_sidecar_checkpoint_fast_open(self, tmp_path):
+        data = self._data(50)
+        st = KesqueStore(str(tmp_path), "account", content_addressed=True)
+        st.append_batch([], data)
+        st.stop()  # checkpoints the sidecar
+        st2 = KesqueStore(str(tmp_path), "account", content_addressed=True)
+        assert not st2.rebuilt_index
+        assert st2.count == len(data)
+        for k, v in data.items():
+            assert st2.get(k) == v
+        st2.stop()
+
+    def test_rebuild_on_missing_sidecar_is_bit_exact(self, tmp_path):
+        data = self._data(50)
+        st = KesqueStore(str(tmp_path), "account", content_addressed=True)
+        st.append_batch([], data)
+        st.stop()
+        sidecar = [
+            n for n in os.listdir(
+                os.path.join(str(tmp_path), "kesque", "account"))
+            if n.endswith(".kidx")
+        ]
+        assert sidecar
+        os.unlink(os.path.join(
+            str(tmp_path), "kesque", "account", sidecar[0]))
+        st2 = KesqueStore(str(tmp_path), "account", content_addressed=True)
+        assert st2.rebuilt_index  # full scan, no sidecar
+        assert st2.count == len(data)
+        assert sorted(st2.keys()) == sorted(data)
+        for k, v in data.items():
+            assert st2.get(k) == v
+        st2.stop()
+
+    def test_stale_sidecar_tail_scan_applies_missing_records(
+            self, tmp_path):
+        """Records appended after the last checkpoint but before a
+        crash are recovered by the tail scan past the sidecar
+        watermarks — no full rebuild, nothing lost."""
+        early, late = self._data(30, tag=1), self._data(30, tag=2)
+        st = KesqueStore(str(tmp_path), "account", content_addressed=True)
+        st.append_batch([], early)
+        st.checkpoint()
+        st.append_batch([], late)  # never checkpointed
+        for seg in st._segments.values():
+            seg.close()  # crash: fds drop, sidecar stays stale
+        st2 = KesqueStore(str(tmp_path), "account", content_addressed=True)
+        assert not st2.rebuilt_index
+        for k, v in {**early, **late}.items():
+            assert st2.get(k) == v
+        st2.stop()
+
+    def test_tombstone_and_overwrite_survive_reopen(self, tmp_path):
+        st = KesqueStore(str(tmp_path), "kv", content_addressed=False)
+        st.append_batch([], {b"a": b"1", b"b": b"2"})
+        st.append_batch([b"b"], {b"a": b"3"})  # delete b, overwrite a
+        st.stop()
+        st2 = KesqueStore(str(tmp_path), "kv", content_addressed=False)
+        assert st2.get(b"a") == b"3"
+        assert st2.get(b"b") is None
+        assert st2.count == 1
+        st2.stop()
+
+
+# ------------------------------------------------- segment ingest
+
+
+class TestSegmentIngest:
+    def _engine_with(self, tmp_path, name, data):
+        eng = KesqueEngine(str(tmp_path / name))
+        eng.store("account").append_batch([], data)
+        return eng
+
+    def test_ingest_chunk_raw_splice_roundtrip(self, tmp_path):
+        data = {keccak256(b"n%d" % i): b"n%d" % i for i in range(200)}
+        src = self._engine_with(tmp_path, "src", data)
+        dst = KesqueEngine(str(tmp_path / "dst"))
+        total = 0
+        for topic, seq, _size in src.list_segments(["account"]):
+            off, done = 0, False
+            while not done:
+                raw, off, done = src.read_chunk(topic, seq, off, 4096)
+                n, corrupt = dst.ingest_chunk(topic, raw)
+                assert corrupt == 0
+                total += n
+        assert total == len(data)
+        for k, v in data.items():
+            assert dst.store("account").get(k) == v
+        dst.stop()
+        # the spliced log is a VALID log: a from-scratch index rebuild
+        # (no sidecar) reproduces every record bit-exact
+        sc_dir = os.path.join(str(tmp_path / "dst"), "kesque", "account")
+        for n in os.listdir(sc_dir):
+            if n.endswith(".kidx"):
+                os.unlink(os.path.join(sc_dir, n))
+        re = KesqueEngine(str(tmp_path / "dst"))
+        assert re.store("account").rebuilt_index
+        for k, v in data.items():
+            assert re.store("account").get(k) == v
+        re.stop(), src.stop()
+
+    def test_ingest_chunk_rejects_foreign_and_torn_frames(self, tmp_path):
+        dst = KesqueEngine(str(tmp_path / "d"))
+        node = encode_node_record(b"good node rlp")
+        put = encode_put_record(b"k", b"not a node")
+        n, corrupt = dst.ingest_chunk("account", frame(node) + frame(put))
+        assert (n, corrupt) == (1, 1)  # node admitted, put rejected
+        torn = frame(node) + frame(encode_node_record(b"lost"))[:7]
+        n, corrupt = dst.ingest_chunk("account", torn)
+        assert n == 1  # the complete frame still lands
+        # a bit-flipped chunk admits NOTHING under a wrong key
+        flipped = bytearray(frame(encode_node_record(b"payload")))
+        flipped[FRAME_HEADER + 3] ^= 0xFF
+        n, _ = dst.ingest_chunk("account", bytes(flipped))
+        assert n == 0
+        for k in dst.store("account").keys():
+            v = dst.store("account").get(k)
+            assert keccak256(v) == k  # every admitted key content-checks
+        dst.stop()
+
+    def test_segment_snapshot_ingest_end_to_end(self, contract_chain,
+                                                tmp_path):
+        """Parallel segment streaming of a real multi-store trie, with
+        the target-root reachability walk — the fast-sync bulk path."""
+        src_bc = _replay_into(Storages(), contract_chain)
+        root = src_bc.get_header_by_number(2).state_root
+        src = KesqueEngine(str(tmp_path / "src"))
+        for topic, store in (
+            ("account", src_bc.storages.account_node_storage),
+            ("storage", src_bc.storages.storage_node_storage),
+            ("evmcode", src_bc.storages.evmcode_storage),
+        ):
+            src.store(topic).append_batch([], {
+                bytes(k): store.get(k) for k in store.source.keys()
+            })
+        dst = Storages(engine="kesque", data_dir=str(tmp_path / "dst"))
+        report = segment_snapshot_ingest(
+            dst, lambda: src.list_segments(), src.read_chunk,
+            target_root=root, workers=3,
+        )
+        assert report.missing == 0 and report.corrupt_nodes == 0
+        assert report.records > 0 and report.corrupt_frames == 0
+        assert dst.app_state.fast_sync_done
+        walk = verify_reachable(
+            dst.account_node_storage, dst.storage_node_storage,
+            dst.evmcode_storage, root, verify_hashes=True,
+        )
+        assert walk.missing == 0 and walk.corrupt == 0
+        assert walk.storage_nodes > 0 and walk.code_blobs > 0
+        tgt_bc = Blockchain(dst, CFG)
+        assert tgt_bc.get_account(ADDRS[1], root).balance == 1005 * ETH
+        dst.stop(), src.stop()
+
+
+# ----------------------------------------------- replay parity
+
+
+class TestReplayParity:
+    @pytest.mark.parametrize("fixture", ["transfer", "contract"])
+    def test_kesque_replays_fixture_bit_exact_vs_sqlite(
+            self, fixture, transfer_chain, contract_chain, tmp_path):
+        chain = transfer_chain if fixture == "transfer" else contract_chain
+        kq = Storages(engine="kesque", data_dir=str(tmp_path / "kq"))
+        sq = Storages(engine="sqlite", data_dir=str(tmp_path / "sq"))
+        bc_kq = _replay_into(kq, chain)
+        bc_sq = _replay_into(sq, chain)
+        _assert_same_chain(bc_kq, bc_sq, len(chain))
+        for n in range(len(chain) + 1):
+            a = bc_kq.get_header_by_number(n)
+            b = bc_sq.get_header_by_number(n)
+            assert a.state_root == b.state_root, f"root {n} diverged"
+        # durability: a restart-style reopen serves the same chain
+        kq.stop(), sq.stop()
+        kq2 = Storages(engine="kesque", data_dir=str(tmp_path / "kq"))
+        bc2 = Blockchain(kq2, _cfg())
+        _assert_same_chain(bc2, bc_sq, len(chain))
+        walk = verify_reachable(
+            kq2.account_node_storage, kq2.storage_node_storage,
+            kq2.evmcode_storage,
+            bc2.get_header_by_number(len(chain)).state_root,
+            verify_hashes=True,
+        )
+        assert walk.missing == 0 and walk.corrupt == 0
+        kq2.stop()
+
+
+# --------------------------------- compaction under concurrent reads
+
+
+class TestCompaction:
+    def test_compaction_under_concurrent_reads_bit_exact(
+            self, transfer_chain, tmp_path):
+        st = Storages(engine="kesque", data_dir=str(tmp_path))
+        bc = _replay_into(st, transfer_chain)
+        root = bc.get_header_by_number(N_BLOCKS).state_root
+        store = st.kesque_engine.store("account")
+        oracle = {k: store.get(k) for k in store.keys()}
+        assert oracle
+        stop_flag = threading.Event()
+        errors = []
+
+        def reader():
+            keys = sorted(oracle)
+            i = 0
+            while not stop_flag.is_set():
+                k = keys[i % len(keys)]
+                v = store.get(k)
+                # a key may vanish mid-compaction (unreachable record
+                # swept); what is NEVER allowed is a wrong byte
+                if v is not None and v != oracle[k]:
+                    errors.append((k, v))
+                i += 1
+
+        readers = [threading.Thread(target=reader) for _ in range(3)]
+        for t in readers:
+            t.start()
+        try:
+            report = st.kesque_engine.compact(root)
+        finally:
+            stop_flag.set()
+            for t in readers:
+                t.join(timeout=10)
+        assert not errors, f"corrupt read during compaction: {errors[:3]}"
+        assert report.corrupt == 0
+        assert report.reclaimed_bytes >= 0
+        assert report.segment_stats["account"]
+        # post-compaction: every surviving record bit-exact, the full
+        # state still verifies, the chain still serves
+        for k in store.keys():
+            assert store.get(k) == oracle[k]
+        walk = verify_reachable(
+            st.account_node_storage, st.storage_node_storage,
+            st.evmcode_storage, root, verify_hashes=True,
+        )
+        assert walk.missing == 0 and walk.corrupt == 0
+        assert bc.best_block_number == N_BLOCKS
+        st.stop()
+
+
+# -------------------------------------------- kill-mid-append sweep
+
+
+def _small_segments(storages, nbytes=1 << 13):
+    """Shrink every topic's roll threshold so the sweep actually
+    crosses segment boundaries (64 MiB segments would never roll on a
+    12-block fixture)."""
+    for store in storages.kesque_engine._stores.values():
+        store.segment_bytes = max(1 << 12, nbytes)
+
+
+def _hard_close(storages):
+    """Simulated process death: drop the crashed instance's fds
+    WITHOUT flushing or checkpointing — a clean ``stop()`` would write
+    the very sidecar the crash is supposed to have lost."""
+    for store in storages.kesque_engine._stores.values():
+        for seg in store._segments.values():
+            seg.close()
+
+
+@pytest.mark.chaos
+class TestKillMidAppendSweep:
+    def test_kill_mid_append_sweep_120_seeds(self, transfer_chain,
+                                             tmp_path):
+        """THE acceptance sweep: 120 seeded deaths across the
+        ``kesque.append`` (chunked frame writes), ``kesque.roll``
+        (segment boundary) and ``kesque.index`` (sidecar checkpoint)
+        seams. Whatever the seed kills, a restart-style reopen of the
+        same data_dir + journal recovery + a serial resume lands on
+        the bit-exact chain."""
+        ref_cfg = _cfg(window=1, depth=1)
+        ref = Blockchain(Storages(), ref_cfg)
+        ref.load_genesis(GenesisSpec(alloc=ALLOC))
+        ReplayDriver(ref, ref_cfg).replay(transfer_chain)
+        sites = ("kesque.append", "kesque.roll", "kesque.index")
+        killed = survived = 0
+        for seed in range(120):
+            d = str(tmp_path / f"s{seed}")
+            cfg = _cfg(window=2, depth=2)
+            st = Storages(engine="kesque", data_dir=d)
+            _small_segments(st)
+            bc = Blockchain(st, cfg)
+            bc.load_genesis(GenesisSpec(alloc=ALLOC))
+            plan = FaultPlan(
+                seed=seed,
+                rules=[FaultRule(sites[seed % len(sites)], "die",
+                                 times=1,
+                                 after=(seed // len(sites)) % 40)],
+            )
+            with active(plan):
+                try:
+                    drv = ReplayDriver(bc, cfg)
+                    drv.replay(transfer_chain[:6])
+                    st.kesque_engine.checkpoint()  # live index seam
+                    drv.replay(transfer_chain[6:])
+                    st.kesque_engine.checkpoint()
+                    survived += 1
+                except (CollectorDied, InjectedDeath):
+                    killed += 1
+            # restart semantics: the crashed instance's memory dies
+            # with it — reopen the SAME data_dir from disk
+            _hard_close(st)
+            st2 = Storages(engine="kesque", data_dir=d)
+            _small_segments(st2)
+            bc2 = Blockchain(st2, cfg)
+            if bc2.get_header_by_number(0) is None:
+                bc2.load_genesis(GenesisSpec(alloc=ALLOC))
+            recover(bc2, config=cfg)
+            assert st2.window_journal.pending() == []
+            if bc2.best_block_number < N_BLOCKS:
+                resume_cfg = _cfg(window=1, depth=1)
+                ReplayDriver(bc2, resume_cfg).replay(
+                    transfer_chain[bc2.best_block_number:]
+                )
+            _assert_same_chain(bc2, ref, N_BLOCKS)
+            _hard_close(st2)
+        # the harness genuinely exercised both outcomes
+        assert killed > 20 and survived > 20, (killed, survived)
+
+
+# ------------------------------------- mixed-backend rebalance join
+
+
+class FakeShard:
+    """In-memory BridgeClient stand-in (test_rebalance shape) — the
+    paged rebalance surface only; ``engine_info`` is answered by the
+    sqlite-flavoured and kesque-flavoured subclasses."""
+
+    def __init__(self):
+        self.store = {}
+        self.fail = False
+
+    def get_node_data(self, hashes):
+        return {h: self.store[h] for h in hashes if h in self.store}
+
+    def put_node_data(self, nodes):
+        self.store.update(nodes)
+        return len(nodes)
+
+    def stream_node_data(self, ranges, cursor, count):
+        from khipu_tpu.cluster.ring import _point
+
+        snap = dict(self.store)
+        keys = sorted(
+            k for k in snap
+            if cursor < k and any(lo <= _point(k) < hi
+                                  for lo, hi in ranges)
+        )
+        page = keys[:count]
+        done = len(keys) <= count
+        nxt = page[-1] if page else bytes(cursor)
+        return done, nxt, [(k, snap[k]) for k in page]
+
+    def ping(self, payload=b""):
+        return payload
+
+    def close(self):
+        pass
+
+
+class SqliteShard(FakeShard):
+    def engine_info(self):
+        return "sqlite", []
+
+
+class KesqueShard(FakeShard):
+    """Kesque-capable shard: paged surface plus the segment-ship
+    surface, served from a shared source engine."""
+
+    def __init__(self, engine):
+        super().__init__()
+        self.engine = engine
+        self.chunk_calls = 0
+        self.fail_chunk_after = None  # test hook: die mid-ship
+        self.corrupt_chunks = False
+
+    def engine_info(self):
+        return "kesque", self.engine.list_segments(["account"])
+
+    def stream_segments(self, topic, seq, offset, max_bytes):
+        self.chunk_calls += 1
+        if (self.fail_chunk_after is not None
+                and self.chunk_calls > self.fail_chunk_after):
+            raise ConnectionError("segment source died mid-ship")
+        raw, nxt, done = self.engine.read_chunk(
+            topic, seq, offset, max_bytes
+        )
+        if self.corrupt_chunks and raw:
+            raw = b"\x00" + raw[1:]
+        return raw, nxt, done
+
+
+def _mixed_cluster(tmp_path, shard_kinds, data, extra_kinds=()):
+    """Cluster where each member is kesque- or sqlite-backed.
+    ``shard_kinds``/``extra_kinds``: {endpoint: "kesque"|"sqlite"}."""
+    from khipu_tpu.cluster import Rebalancer, ShardedNodeClient
+
+    engine = KesqueEngine(str(tmp_path / "ship_src"))
+    engine.store("account").append_batch([], data)
+    shards = {}
+    for ep, kind in {**shard_kinds, **dict(extra_kinds)}.items():
+        shards[ep] = (KesqueShard(engine) if kind == "kesque"
+                      else SqliteShard())
+    cl = ShardedNodeClient(
+        list(shard_kinds),
+        channel_factory=lambda ep: shards[ep],
+        replication=2, vnodes=8, max_retries=1, sleep=lambda s: None,
+    )
+    rb = Rebalancer(cl, batch=64)
+    cl.replicate(data)
+    return cl, rb, shards, engine
+
+
+def _dataset(n):
+    vals = [b"mpt node rlp bytes #%d" % i for i in range(n)]
+    return {keccak256(v): v for v in vals}
+
+
+class TestMixedBackendRebalance:
+    def test_mixed_backends_negotiate_down_and_land_new_epoch(
+            self, tmp_path):
+        """One sqlite member in the ring: negotiation must fall back
+        to the paged transport (zero segment chunks) and the join
+        still lands at EXACTLY the new epoch, bit-exact."""
+        data = _dataset(300)
+        cl, rb, shards, eng = _mixed_cluster(
+            tmp_path,
+            {"a": "kesque", "b": "kesque", "c": "sqlite"},
+            data, extra_kinds={"d": "kesque"},
+        )
+        e0 = cl.ring.epoch
+        streamed = rb.join("d")
+        assert streamed > 0
+        assert cl.ring.epoch == e0 + 1  # exactly the new epoch
+        assert not cl.ring.in_transition
+        assert rb.segment_chunks == 0  # negotiated down
+        assert cl.fetch(list(data)) == data
+        eng.stop()
+
+    def test_all_kesque_join_uses_segment_ship(self, tmp_path):
+        data = _dataset(300)
+        cl, rb, shards, eng = _mixed_cluster(
+            tmp_path,
+            {"a": "kesque", "b": "kesque", "c": "kesque"},
+            data, extra_kinds={"d": "kesque"},
+        )
+        e0 = cl.ring.epoch
+        streamed = rb.join("d")
+        assert streamed > 0
+        assert rb.segment_chunks > 0  # the bulk transport ran
+        assert cl.ring.epoch == e0 + 1
+        assert not cl.ring.in_transition
+        assert cl.fetch(list(data)) == data
+        # every key the new epoch assigns to d actually landed on d
+        for k, v in data.items():
+            if "d" in cl.ring.replicas_for(k):
+                assert shards["d"].store[k] == v
+        eng.stop()
+
+    def test_ship_failure_mid_stream_falls_back_and_lands_exactly(
+            self, tmp_path):
+        """The source dies mid segment-ship: the join must end at
+        exactly the old or the new epoch — here the paged fallback
+        completes it at the new one, with full readback."""
+        data = _dataset(300)
+        cl, rb, shards, eng = _mixed_cluster(
+            tmp_path,
+            {"a": "kesque", "b": "kesque", "c": "kesque"},
+            data, extra_kinds={"d": "kesque"},
+        )
+        for sh in shards.values():
+            if isinstance(sh, KesqueShard):
+                sh.fail_chunk_after = 1
+        e0 = cl.ring.epoch
+        rb.join("d")
+        assert cl.ring.epoch in (e0, e0 + 1)
+        assert cl.ring.epoch == e0 + 1  # fallback completed the join
+        assert not cl.ring.in_transition
+        assert cl.fetch(list(data)) == data
+        eng.stop()
+
+    def test_corrupt_chunk_detected_and_fallback_lands_exactly(
+            self, tmp_path):
+        data = _dataset(200)
+        cl, rb, shards, eng = _mixed_cluster(
+            tmp_path,
+            {"a": "kesque", "b": "kesque", "c": "kesque"},
+            data, extra_kinds={"d": "kesque"},
+        )
+        for sh in shards.values():
+            if isinstance(sh, KesqueShard):
+                sh.corrupt_chunks = True
+        e0 = cl.ring.epoch
+        rb.join("d")
+        assert cl.ring.epoch == e0 + 1 and not cl.ring.in_transition
+        assert cl.fetch(list(data)) == data  # nothing corrupt admitted
+        eng.stop()
+
+    def test_abort_mid_join_stays_at_old_epoch(self, tmp_path):
+        """The other half of exactly-old-or-new: a death on the
+        rebalance stream seam aborts the transition — the ring stays
+        at the OLD epoch, not in between."""
+        data = _dataset(200)
+        cl, rb, shards, eng = _mixed_cluster(
+            tmp_path,
+            {"a": "kesque", "b": "kesque", "c": "sqlite"},
+            data, extra_kinds={"d": "kesque"},
+        )
+        e0 = cl.ring.epoch
+        plan = FaultPlan(
+            seed=7,
+            rules=[FaultRule("rebalance.stream", "die", times=1)],
+        )
+        with active(plan):
+            with pytest.raises(InjectedDeath):
+                rb.join("d")
+        # mid-join death: the COMMITTED epoch is still the old one and
+        # serves bit-exact — never a half-epoch
+        assert cl.ring.epoch == e0
+        assert set(cl.ring.members) == {"a", "b", "c"}
+        assert cl.fetch(list(data)) == data
+        # recovery settles the open transition to exactly old or new
+        outcome = rb.recover()
+        assert outcome in ("resumed", "rolled_back")
+        assert cl.ring.epoch in (e0, e0 + 1)
+        assert not cl.ring.in_transition
+        assert cl.fetch(list(data)) == data
+        eng.stop()
+
+
+# -------------------------------------------------- observability
+
+
+class TestObservability:
+    def test_engine_registry_families_once_each(self, tmp_path):
+        eng = KesqueEngine(str(tmp_path))
+        eng.store("account").append_batch(
+            [], {keccak256(b"x"): b"x"}
+        )
+        names = [s[0] for s in eng._registry_samples()]
+        for fam in (
+            "khipu_kesque_segments",
+            "khipu_kesque_live_bytes",
+            "khipu_kesque_garbage_bytes",
+            "khipu_kesque_index_entries",
+            "khipu_kesque_appended_bytes_total",
+            "khipu_kesque_reclaimed_bytes_total",
+            "khipu_kesque_torn_bytes_total",
+            "khipu_kesque_compactions_total",
+            "khipu_kesque_read_amplification",
+        ):
+            assert names.count(fam) == 1, fam
+        eng.stop()
