@@ -57,6 +57,39 @@ def cpu_scalar_baseline(length: int = 576, iters: int = 20000) -> float:
     return iters / (time.perf_counter() - t0)
 
 
+def host_speed_score(batches: int = 40, rows: int = 64,
+                     length: int = 576) -> float:
+    """Keccak microworkload score (hashes/s, best of 3) — a scalar
+    proxy for how fast THIS host runs the bench's dominant compute
+    (sender recovery, trie hashing, and mapping-slot derivation all
+    bottom out in keccak). --capture stamps it into the baseline and
+    --compare re-measures it, normalizing every blocks/s ratio by
+    score_base / score_now, so a slower re-run host (the r09 -> r10
+    incident, where the headline drop was pure host variance) reads as
+    host speed instead of a code regression. Best-of-3 because the
+    score must track the host's ceiling, not a scheduler hiccup inside
+    one sample. Uses the native batch keccak when it is importable —
+    that is the primitive the replay hot path actually pays for — with
+    the hashlib scalar as the stand-in everywhere else."""
+    blobs = [b"\xa5" * length] * rows
+    try:
+        from khipu_tpu.native.keccak import keccak256_batch
+
+        def work():
+            for _ in range(batches):
+                keccak256_batch(blobs)
+    except Exception:  # native lib unavailable: scalar stand-in
+        def work():
+            for _ in range(batches * rows):
+                hashlib.sha3_256(blobs[0]).digest()
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        work()
+        best = max(best, batches * rows / (time.perf_counter() - t0))
+    return round(best, 1)
+
+
 def _replay_keys(nsenders, seed_base=1):
     from khipu_tpu.base.crypto.secp256k1 import (
         privkey_to_pubkey,
@@ -609,14 +642,21 @@ def bench_replay_conflict_storm(n_blocks=16, txs_per_block=50,
 
 def bench_replay_mixed_contract(n_blocks=12, txs_per_block=40,
                                 call_fraction=0.6, window=8):
-    """ISSUE 14 adversarial fixture #2: the fast path must NOT carry
-    this one. ``call_fraction`` of each block's txs call a counter
-    contract whose SSTORE slot is a CONSTANT (slot 0) — underivable
-    from caller or calldata, so the template learner marks the code
-    opaque and every call lands in the interpreter residue; the rest
-    are plain transfers. Pins fast_path_coverage below 0.5: the
-    scheduler's coverage number must reflect real residue traffic,
-    not quietly misclassify opaque calls as batchable."""
+    """Mixed contract/transfer traffic: ``call_fraction`` of each
+    block's txs call a counter contract whose SSTORE slot is a
+    CONSTANT (slot 0); the rest are plain transfers. Under ISSUE 14's
+    caller/arg-only derivation this was the adversarial fixture the
+    fast path could NOT carry (coverage pinned < 0.5). ISSUE 17's
+    ``("const", slot)`` rule makes the constant slot derivable, the
+    purity scan proves the counter straight-line, and after one
+    observed + TRUST_AFTER checked blocks the calls execute in the
+    trusted vectorized lane — so the SAME fixture now pins the
+    opposite claim: steady-state fast_path_coverage must CLEAR the
+    gate floor (~0.9 here; every call past the warmup blocks plus
+    every transfer is batched). Same-slot calls still conflict, so
+    the counter calls serialize into width-1 batches — the fixture
+    keeps the scheduler honest about conflicts while the templated
+    executor absorbs the interpreter cost."""
     from khipu_tpu.domain.transaction import (
         Transaction,
         contract_address,
@@ -686,6 +726,129 @@ def bench_replay_mixed_contract(n_blocks=12, txs_per_block=40,
         txs=stats.txs,
         conflicts=stats.conflicts,
         call_fraction=call_fraction,
+        window=window,
+        n_blocks=n_blocks,
+        txs_per_block=txs_per_block,
+        native_evm=native_available(),
+        phases=stats.phase_line(),
+        pipeline_occupancy=round(stats.pipeline_occupancy, 4),
+        **_exec_metrics(stats),
+    )
+
+
+# ERC-20 transfer(to, amount) with REAL keccak mapping slots: balances
+# live at keccak(pad32(holder) ++ pad32(0)) — sender slot debits by the
+# amount word, recipient slot credits. Calldata is the raw two words
+# (no ABI selector), so arg0 = recipient, arg1 = amount. Straight-line
+# and fully whitelisted for the purity scan (const memory offsets, const
+# SHA3 size), which is what lets the learner derive ("map_caller", 0) /
+# ("map_arg", 0, 0) write rules and trust the code after confirmation.
+_ERC20_RUNTIME = bytes([
+    0x33,                    # CALLER
+    0x60, 0x00, 0x52,        # PUSH1 0  MSTORE   mem[0:32] = caller
+    0x60, 0x00,              # PUSH1 0  (mapping base slot)
+    0x60, 0x20, 0x52,        # PUSH1 32 MSTORE   mem[32:64] = 0
+    0x60, 0x40, 0x60, 0x00,  # PUSH1 64 PUSH1 0
+    0x20,                    # SHA3              sender slot
+    0x80, 0x54,              # DUP1 SLOAD        sender balance
+    0x60, 0x20, 0x35,        # PUSH1 32 CALLDATALOAD   amount
+    0x90, 0x03,              # SWAP1 SUB         bal - amount
+    0x90, 0x55,              # SWAP1 SSTORE      debit sender
+    0x60, 0x00, 0x35,        # PUSH1 0 CALLDATALOAD    recipient
+    0x60, 0x00, 0x52,        # PUSH1 0  MSTORE   mem[0:32] = recipient
+    0x60, 0x40, 0x60, 0x00,  # PUSH1 64 PUSH1 0  (mem[32:64] still 0)
+    0x20,                    # SHA3              recipient slot
+    0x80, 0x54,              # DUP1 SLOAD        recipient balance
+    0x60, 0x20, 0x35,        # PUSH1 32 CALLDATALOAD   amount
+    0x01,                    # ADD               bal + amount
+    0x90, 0x55,              # SWAP1 SSTORE      credit recipient
+    0x00,                    # STOP
+])
+
+
+def bench_replay_erc20_heavy(n_blocks=16, txs_per_block=40, window=8):
+    """ISSUE 17 fixture: mapping-write-dominated ERC-20 traffic — the
+    workload the templated-call lane exists for. Every tx past the
+    deploy block is a token ``transfer(to, amount)`` against ONE
+    contract whose balances are a REAL keccak mapping: two SSTOREs per
+    call at keccak(pad32(holder) ++ pad32(0)). Holders are all
+    distinct (40 senders paying 64 disjoint receiver addresses) and
+    the amounts VARY per call, so the learner must prove the
+    ``old -/+ arg1`` effect shape, not memorize one delta. Block 1
+    observes (interpreter residue), blocks 2..1+TRUST_AFTER confirm
+    (checked lane), everything after executes as width-40 vectorized
+    batches whose slot keys come from ONE native keccak256_batch call
+    per block. Steady-state fast_path_coverage lands ~0.8 (the gate
+    pins a per-fixture floor); the execute phase share must stay
+    under the watchdog's 0.9 ceiling WITH the vectorized lane doing
+    the carrying — on the interpreter path this fixture buries the
+    driver."""
+    from khipu_tpu.domain.transaction import (
+        Transaction,
+        contract_address,
+        sign_transaction,
+    )
+
+    nsenders = txs_per_block  # one tx per sender per block
+    keys, addrs = _replay_keys(nsenders, seed_base=501)
+    alloc = {a: 10**24 for a in addrs}
+
+    runtime = _ERC20_RUNTIME
+    # the runtime is wider than one word, so the constructor CODECOPYs
+    # it out of the init code instead of the counter's PUSH32 trick
+    init = bytes([
+        0x60, len(runtime),   # PUSH1 len
+        0x60, 0x0C,           # PUSH1 12 (runtime offset in init code)
+        0x60, 0x00,           # PUSH1 0
+        0x39,                 # CODECOPY
+        0x60, len(runtime),   # PUSH1 len
+        0x60, 0x00,           # PUSH1 0
+        0xF3,                 # RETURN
+    ]) + runtime
+    token = contract_address(addrs[0], 0)
+    holders = [
+        bytes.fromhex("%040x" % (0xE20E2000 + i)) for i in range(64)
+    ]
+
+    def build(builder):
+        blocks = [
+            builder.add_block(
+                [sign_transaction(
+                    Transaction(0, 10**9, 500_000, None, 0, payload=init),
+                    keys[0], chain_id=1,
+                )],
+                coinbase=b"\xaa" * 20,
+            )
+        ]
+        nonces = [1] + [0] * (nsenders - 1)
+        for n in range(n_blocks):
+            txs = []
+            for j in range(txs_per_block):
+                # distinct recipient per tx within a block: the 40
+                # calls stay pairwise slot-disjoint -> one batch
+                rcpt = holders[(j + n * 7) % len(holders)]
+                amount = 1_000 + 13 * j + n  # varied, never constant
+                payload = (
+                    rcpt.rjust(32, b"\x00")
+                    + amount.to_bytes(32, "big")
+                )
+                tx = Transaction(
+                    nonces[j], 10**9, 200_000, token, 0, payload=payload,
+                )
+                txs.append(sign_transaction(tx, keys[j], chain_id=1))
+                nonces[j] += 1
+            blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
+        return blocks
+
+    stats = _replay_fixture(True, window, alloc, build, device_commit=True)
+    from khipu_tpu.evm.native_vm import available as native_available
+
+    emit(
+        "replay_erc20_heavy_blocks_per_sec",
+        round(stats.blocks_per_s, 2),
+        "blocks/s",
+        txs=stats.txs,
+        conflicts=stats.conflicts,
         window=window,
         n_blocks=n_blocks,
         txs_per_block=txs_per_block,
@@ -1103,6 +1266,18 @@ DEFAULT_COMPARE_THRESHOLDS = {
     # skipped when the baseline predates the ledger and has no movement
     # numbers (BENCH_r05 does not)
     "max_bytes_per_block_ratio": 1.25,
+    # per-fixture fast_path_coverage floors (ISSUE 17): these fixtures
+    # replay mapping-write / constant-slot contract traffic the
+    # templated-call lane is supposed to carry — coverage collapsing
+    # below the floor means templates stopped promoting (learner
+    # regression) even if blocks/s happens to stay inside the ratio.
+    # Checked against the CURRENT run, baseline or not. Both measure
+    # ~0.998 warm; 0.8 is the acceptance floor with headroom for a
+    # fixture reshape, not for a lane outage
+    "min_fast_path_coverage": {
+        "replay_mixed_contract_blocks_per_sec": 0.8,
+        "replay_erc20_heavy_blocks_per_sec": 0.8,
+    },
 }
 
 
@@ -1157,24 +1332,47 @@ def _baseline_bytes_per_block(line):
     return None
 
 
-def _compare_line(line, base, bytes_per_block, th):
+def _compare_line(line, base, bytes_per_block, th, speed_adjust=None):
     metric = line["metric"]
     out = {"metric": metric, "failures": []}
     if bytes_per_block is not None:
         out["bytes_per_block"] = round(bytes_per_block)
+    # coverage floor judges the CURRENT run alone — a new fixture with
+    # no baseline entry still fails the gate if its lane collapsed
+    floor = (th.get("min_fast_path_coverage") or {}).get(metric)
+    cov = line.get("fast_path_coverage")
+    if floor is not None and cov is not None:
+        out["fast_path_coverage"] = cov
+        if cov < floor:
+            out["failures"].append(
+                f"{metric}: fast_path_coverage {cov} < floor {floor}"
+            )
     if base is None:
         out["note"] = "no baseline entry (skipped)"
         return out
     if line.get("unit") == "blocks/s" and base.get("value"):
-        ratio = line["value"] / base["value"]
-        out["blocks_per_s"] = line["value"]
+        measured = line["value"]
+        # host-speed normalization: when both captures carry a
+        # host_speed_score, judge the ratio on the score-adjusted
+        # number (measured * score_base / score_now) so a faster or
+        # slower re-run host doesn't masquerade as a code change;
+        # baselines without a score (r10 and older) compare raw
+        adjusted = measured * speed_adjust if speed_adjust else measured
+        ratio = adjusted / base["value"]
+        out["blocks_per_s"] = measured
         out["baseline_blocks_per_s"] = base["value"]
         out["ratio"] = round(ratio, 3)
+        if speed_adjust:
+            out["host_speed_adjust"] = round(speed_adjust, 3)
+            out["adjusted_blocks_per_s"] = round(adjusted, 2)
         if ratio < th["min_blocks_per_s_ratio"]:
             out["failures"].append(
                 f"{metric}: blocks/s ratio {ratio:.3f} < "
                 f"{th['min_blocks_per_s_ratio']} "
-                f"({line['value']} vs baseline {base['value']})"
+                f"({line['value']} vs baseline {base['value']}"
+                + (f", host-speed adjust {speed_adjust:.3f}x"
+                   if speed_adjust else "")
+                + ")"
             )
     share_now = _collect_share(line)
     share_base = _collect_share(base)
@@ -1367,11 +1565,21 @@ def bench_compare(path, thresholds=None, runners=None, diff=False):
     the differential analyzer against the baseline line, so a gate
     failure prints WHICH phase/site moved, not just that the headline
     ratio tripped."""
+    from khipu_tpu.ledger.schedule import reset_learner
     from khipu_tpu.observability.profiler import LEDGER
+    from khipu_tpu.sync.prefetch import flush_sender_cache
 
     th = dict(DEFAULT_COMPARE_THRESHOLDS)
     th.update(thresholds or {})
     base = parse_baseline(path)
+    # host-speed normalization factor: re-measure the keccak score on
+    # THIS host and scale every blocks/s ratio by score_base/score_now.
+    # Guarded — r10 and older captures predate the score and compare raw
+    speed_adjust = None
+    score_now = host_speed_score()
+    base_score = (base.get("host_speed_score") or {}).get("value")
+    if base_score and score_now:
+        speed_adjust = base_score / score_now
     if runners is None:
         runners = [
             lambda: bench_replay(
@@ -1384,6 +1592,9 @@ def bench_compare(path, thresholds=None, runners=None, diff=False):
             # ("no baseline entry (skipped)") until the next capture
             bench_replay_conflict_storm,
             bench_replay_mixed_contract,
+            # ISSUE 17 fixture: mapping-write-dominated ERC-20 traffic
+            # (no pre-r11 baseline entry; tolerated the same way)
+            bench_replay_erc20_heavy,
         ]
     failures = []
     comparisons = []
@@ -1391,6 +1602,12 @@ def bench_compare(path, thresholds=None, runners=None, diff=False):
     try:
         for run in runners:
             LEDGER.reset()  # per-config movement numbers
+            # per-config COLD start for the cross-fixture caches too:
+            # templates learned by one fixture's contracts and senders
+            # recovered for its keys must not subsidize the next
+            # config's number (the baseline was captured the same way)
+            reset_learner()
+            flush_sender_cache()
             mark = len(_EMITTED)
             run()
             bpb = None
@@ -1408,7 +1625,9 @@ def bench_compare(path, thresholds=None, runners=None, diff=False):
                 }
             for line in _EMITTED[mark:]:
                 base_line = base.get(line["metric"])
-                cmp = _compare_line(line, base_line, bpb, th)
+                cmp = _compare_line(
+                    line, base_line, bpb, th, speed_adjust=speed_adjust
+                )
                 if movement:
                     cmp["movement"] = movement
                 if diff and base_line is not None:
@@ -1431,6 +1650,11 @@ def bench_compare(path, thresholds=None, runners=None, diff=False):
         "failures",
         baseline=path,
         thresholds=th,
+        host_speed_score=score_now,
+        baseline_host_speed_score=base_score,
+        **({"host_speed_adjust": round(speed_adjust, 3)}
+           if speed_adjust else
+           {"host_speed_note": "baseline has no score; ratios raw"}),
         comparisons=comparisons,
         **({"failed": failures} if failures else {}),
     )
@@ -1445,7 +1669,9 @@ def bench_capture(out_path, runners=None):
     collect-phase d2h) — a baseline captured this way lets the next
     --compare enforce the bytes-per-block ratio instead of skipping it
     (pre-ledger captures like BENCH_r05 have no movement numbers)."""
+    from khipu_tpu.ledger.schedule import reset_learner
     from khipu_tpu.observability.profiler import LEDGER
+    from khipu_tpu.sync.prefetch import flush_sender_cache
 
     if runners is None:
         runners = [
@@ -1456,15 +1682,29 @@ def bench_capture(out_path, runners=None):
             bench_replay_contended,
             bench_replay_conflict_storm,
             bench_replay_mixed_contract,
+            bench_replay_erc20_heavy,
             # storage-engine gate: ingest delta vs sqlite rides the
             # capture so BENCH_rNN documents the Kesque numbers
             lambda: bench_ingest(smoke=False),
         ]
     lines = []
+    # host-speed stamp FIRST: the score a future --compare divides by
+    # must describe the host that produced the blocks/s lines below
+    emit(
+        "host_speed_score", host_speed_score(), "hashes/s",
+        note="keccak microworkload; --compare normalizes blocks/s by "
+             "score_base/score_now",
+    )
+    lines.append(dict(_EMITTED[-1]))
     LEDGER.enable()
     try:
         for run in runners:
             LEDGER.reset()  # per-config movement numbers
+            # cold cross-fixture caches per config, mirroring
+            # bench_compare: learned templates and recovered senders
+            # must not leak across the config boundary
+            reset_learner()
+            flush_sender_cache()
             mark = len(_EMITTED)
             run()
             movement = {}
@@ -1824,6 +2064,11 @@ def bench_serve(smoke=False):
             "khipu_exec_batch_fallbacks",
             "khipu_exec_batch_templates",
             "khipu_exec_batch_opaque_codes",
+            # ISSUE 17 families: the trusted templated-call lane
+            "khipu_exec_batch_vector_call_txs",
+            "khipu_exec_batch_checked_call_txs",
+            "khipu_exec_batch_trusted_templates",
+            "khipu_exec_batch_effect_retirements",
         ):
             n = text.count(f"# TYPE {fam} gauge")
             assert n == 1, f"{fam} TYPE lines: {n}"
@@ -2639,6 +2884,7 @@ def main() -> None:
     bench_replay_contended()
     bench_replay_conflict_storm()
     bench_replay_mixed_contract()
+    bench_replay_erc20_heavy()
     bench_parallel_scaling()
     bench_bulk_build()
     bench_snapshot_verify()

@@ -174,6 +174,15 @@ class SyncConfig:
     # EWMA flip if the windows prove it wrong)
     adaptive_probe: bool = True
     adaptive_d2d_margin: float = 1.5
+    # execute-stage device dispatch (ISSUE 17): ship the gathered
+    # account-row tiles of a window's fast-path batches through the
+    # fused device validation kernel (trie/fused.py, exec.batch_device
+    # ledger site). Opt-in CAP like device_mirror_commit — even when
+    # True the dispatch engages only where the adaptive probe shows
+    # real device memory (d2d beats memcpy by adaptive_d2d_margin);
+    # the host numpy pass stays the default and the bit-exactness
+    # oracle either way
+    exec_device: bool = False
     # EWMA smoothing over per-window per-hash seal cost observations
     adaptive_ewma_alpha: float = 0.4
     # Schmitt trigger: flip device -> host when the device EWMA
@@ -342,7 +351,12 @@ class TelemetryConfig:
     # dead, cache thrashing, or prefetch disabled in a config that
     # expects it). "execute" guards the scheduled fast path the same
     # way: sustained > 0.9 means the batch executor stopped carrying
-    # its share (e.g. everything mispredicting into fallback).
+    # its share (e.g. everything mispredicting into fallback). The
+    # ceiling is calibrated against the WORST-case carried fixture:
+    # erc20_heavy (two mapping SSTOREs per tx, all contract calls)
+    # measures ~0.45 execute share with the templated lane working and
+    # buries the driver past 0.9 only when the calls fall back to the
+    # interpreter — so a trip is a lane outage, not fixture noise.
     phase_share_ceilings: tuple = (("window.seal", 0.3),
                                    ("window.pack", 0.85),
                                    ("senders", 0.45),

@@ -75,48 +75,50 @@ def _ge_limbs(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return ge | ~decided  # undecided after 4 limbs == equal
 
 
-def execute_fast_batch(
-    config, world, items: Sequence[Tuple[int, object, bytes]],
-) -> List["TxResult"]:
-    """Execute one disjoint batch of plain transfers against ``world``
-    (the block's merged world — mutated in place). ``items`` is
-    [(tx_index, stx, sender), ...]; results come back in batch order
-    with world=``world`` (the batch shares it, like the serial fold).
+# ---- shared gather -> validate skeleton (used by batch_call too) ----
+
+
+def check_tx_scalars(config, index: int, stx, intrinsic: int) -> None:
+    """Scalar signature/intrinsic validation for one batched tx —
+    the non-row-data prefix of _validate_stx, shared by the transfer
+    and templated-call batch executors."""
+    from khipu_tpu.ledger.ledger import TxValidationError
+
+    tx = stx.tx
+    if config.homestead and stx.s > HALF_N:
+        raise TxValidationError(index, "high s (EIP-2)")
+    cid = stx.chain_id
+    if cid is not None:
+        if not config.eip155:
+            raise TxValidationError(index, "EIP-155 v before fork")
+        if cid != config.chain_id:
+            raise TxValidationError(index, f"wrong chain id {cid}")
+    if tx.gas_limit < intrinsic:
+        raise TxValidationError(
+            index, f"gas limit {tx.gas_limit} < intrinsic {intrinsic}"
+        )
+
+
+def gather_validate_rows(world, rows, device_validate=None) -> None:
+    """Gather every sender's nonce/balance row out of ``world``
+    (recorded reads, same as the interpreter's validation probe) and
+    validate the whole batch in one vectorized pass: nonce equality
+    plus the 256-bit limb-lexicographic balance >= upfront compare.
+
+    ``rows`` is [(tx_index, stx, sender, upfront), ...]. When
+    ``device_validate`` is given (the trie/fused.py exec-validate
+    kernel, gated by the adaptive probe), the compare runs on device;
+    it may raise FusedUnsupported to decline, and the host numpy pass
+    is the authoritative fallback either way.
     """
-    from khipu_tpu.ledger.ledger import TxResult, TxValidationError
+    from khipu_tpu.ledger.ledger import TxValidationError
 
-    n = len(items)
-    intrinsic = config.intrinsic_gas(b"", False)
-
-    # ---- scalar signature/intrinsic checks (cheap, non-row data)
-    for index, stx, sender in items:
-        tx = stx.tx
-        if config.homestead and stx.s > HALF_N:
-            raise TxValidationError(index, "high s (EIP-2)")
-        cid = stx.chain_id
-        if cid is not None:
-            if not config.eip155:
-                raise TxValidationError(index, "EIP-155 v before fork")
-            if cid != config.chain_id:
-                raise TxValidationError(index, f"wrong chain id {cid}")
-        if tx.gas_limit < intrinsic:
-            raise TxValidationError(
-                index, f"gas limit {tx.gas_limit} < intrinsic {intrinsic}"
-            )
-        # the planner probed the PARENT state for code; an internal
-        # CREATE earlier this block can deposit code mid-chain — the
-        # merged world is the authority
-        if world.get_code_hash(tx.to) != EMPTY_CODE_HASH:
-            raise Misprediction(index, "code appeared at transfer target")
-
-    # ---- gather: account rows for every sender (recorded reads)
     tx_nonces = []
     acct_nonces = []
     balances = []
     upfronts = []
-    for index, stx, sender in items:
+    for index, stx, sender, upfront in rows:
         tx = stx.tx
-        upfront = tx.gas_limit * tx.gas_price + tx.value
         nonce = world.get_nonce(sender)
         balance = world.get_balance(sender)
         if (tx.nonce > _U64 or nonce > _U64 or balance >= _U256
@@ -127,16 +129,24 @@ def execute_fast_batch(
         balances.append(balance)
         upfronts.append(upfront)
 
-    # ---- validate: one vectorized pass over the whole batch
-    nonce_ok = np.array(tx_nonces, dtype=np.uint64) == np.array(
-        acct_nonces, dtype=np.uint64
-    )
-    balance_ok = _ge_limbs(_limbs(balances), _limbs(upfronts))
-    ok = nonce_ok & balance_ok
+    ok = None
+    if device_validate is not None:
+        try:
+            ok = np.asarray(device_validate(
+                tx_nonces, acct_nonces, balances, upfronts
+            ), dtype=bool)
+        except Exception:
+            ok = None  # device declined — host path is authoritative
+    if ok is None:
+        nonce_ok = np.array(tx_nonces, dtype=np.uint64) == np.array(
+            acct_nonces, dtype=np.uint64
+        )
+        balance_ok = _ge_limbs(_limbs(balances), _limbs(upfronts))
+        ok = nonce_ok & balance_ok
     if not bool(ok.all()):
         i = int(np.argmin(ok))
-        index, stx, _ = items[i]
-        if not nonce_ok[i]:
+        index, stx, _, _ = rows[i]
+        if stx.tx.nonce != acct_nonces[i]:
             raise TxValidationError(
                 index,
                 f"nonce {stx.tx.nonce} != account {acct_nonces[i]}",
@@ -145,6 +155,36 @@ def execute_fast_batch(
             index,
             f"balance {balances[i]} < upfront {upfronts[i]}",
         )
+
+
+def execute_fast_batch(
+    config, world, items: Sequence[Tuple[int, object, bytes]],
+    device_validate=None,
+) -> List["TxResult"]:
+    """Execute one disjoint batch of plain transfers against ``world``
+    (the block's merged world — mutated in place). ``items`` is
+    [(tx_index, stx, sender), ...]; results come back in batch order
+    with world=``world`` (the batch shares it, like the serial fold).
+    """
+    from khipu_tpu.ledger.ledger import TxResult
+
+    intrinsic = config.intrinsic_gas(b"", False)
+
+    # ---- scalar signature/intrinsic checks (cheap, non-row data)
+    for index, stx, sender in items:
+        check_tx_scalars(config, index, stx, intrinsic)
+        # the planner probed the PARENT state for code; an internal
+        # CREATE earlier this block can deposit code mid-chain — the
+        # merged world is the authority
+        if world.get_code_hash(stx.tx.to) != EMPTY_CODE_HASH:
+            raise Misprediction(index, "code appeared at transfer target")
+
+    # ---- gather + validate: one vectorized pass over the whole batch
+    gather_validate_rows(world, [
+        (index, stx, sender,
+         stx.tx.gas_limit * stx.tx.gas_price + stx.tx.value)
+        for index, stx, sender in items
+    ], device_validate=device_validate)
 
     # ---- scatter: per-row commutative deltas (exact interpreter net
     # effect: nonce+1, sender -(value + gas*price), recipient +value)
@@ -159,4 +199,10 @@ def execute_fast_batch(
         results.append(
             TxResult(world, intrinsic, fee, [], 1, None)
         )
+    # the elided EIP-161 sweep's ONLY residual obligation: drop this
+    # batch's touch marks, like execute_transaction's end-of-tx clear —
+    # a stale mark would leak into the NEXT interpreter tx's sweep,
+    # whose get_account probes would then escape that tx's predicted
+    # footprint
+    world.touched.clear()
     return results

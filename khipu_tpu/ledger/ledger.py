@@ -388,6 +388,8 @@ def execute_block(
             )
 
         set_trace(_trace)
+    rewards_paid = False
+    validated_scheduled = False
     try:
         if khipu_config.sync.parallel_tx and len(txs) > 1 and not traced:
             world = receipts = gas_used = None
@@ -397,14 +399,33 @@ def execute_block(
             if khipu_config.sync.scheduled_tx and config.byzantium:
                 from khipu_tpu.ledger.schedule import (
                     EXEC_GAUGES,
+                    LEARNER,
                     Misprediction,
                 )
 
+                trusted_used = set()
                 try:
-                    world, receipts, gas_used = _execute_scheduled(
-                        config, block_env, txs, senders,
-                        parent_state_root, make_world, header, stats,
+                    world, receipts, gas_used, trusted_used = (
+                        _execute_scheduled(
+                            config, block_env, txs, senders,
+                            parent_state_root, make_world, header,
+                            stats, khipu_config.sync,
+                        )
                     )
+                    if trusted_used and validate:
+                        # commit-or-discard for the vectorized trusted
+                        # lane: prove the header oracle NOW, while the
+                        # whole-block optimistic fallback is still
+                        # available — a trusted template that produces
+                        # a wrong root demotes (never oscillates back)
+                        # and the block re-runs without it, bit-exact
+                        _pay_rewards(world, block, khipu_config)
+                        rewards_paid = True
+                        _validate_after(
+                            block, world, receipts, gas_used,
+                            check_root, hasher,
+                        )
+                        validated_scheduled = True
                 except (Misprediction, TxValidationError) as e:
                     # the scheduled attempt is void: discard its world
                     # AND its stats, then re-run the whole block on the
@@ -419,6 +440,23 @@ def execute_block(
                     stats.fast_path_txs = 0
                     stats.residue_txs = 0
                     world = None
+                    rewards_paid = False
+                except ValidationAfterExecError:
+                    if not trusted_used:
+                        raise  # scheduled-but-unvectorized roots are
+                        # authoritative — this would be a real bug
+                    for ch in trusted_used:
+                        LEARNER.demote(ch)
+                    stats.mispredicted_txs += 1
+                    EXEC_GAUGES["mispredictions"] += 1
+                    EXEC_GAUGES["fallbacks"] += 1
+                    stats.parallel_count = 0
+                    stats.conflict_count = 0
+                    stats.fast_path_txs = 0
+                    stats.residue_txs = 0
+                    world = None
+                    rewards_paid = False
+                    validated_scheduled = False
             if world is None:
                 world, receipts, gas_used = _execute_optimistic(
                     config, block_env, txs, senders, parent_state_root,
@@ -436,11 +474,12 @@ def execute_block(
 
             set_trace(None)
 
-    _pay_rewards(world, block, khipu_config)
+    if not rewards_paid:
+        _pay_rewards(world, block, khipu_config)
     stats.gas_used = gas_used
     stats.exec_seconds = time.perf_counter() - t0
 
-    if validate:
+    if validate and not validated_scheduled:
         _validate_after(block, world, receipts, gas_used, check_root, hasher)
     return BlockResult(world, receipts, gas_used, stats)
 
@@ -469,31 +508,44 @@ def _execute_sequential(
 
 def _execute_scheduled(
     config, block_env, txs, senders, parent_root, make_world, header,
-    stats: Stats,
+    stats: Stats, sync_cfg=None,
 ):
     """Conflict-aware scheduled execution (schedule.plan_block) on ONE
     merged world — zero merge conflicts by construction.
 
     Steps run in plan order: each batch's plain transfers go through
-    the vectorized executor, its template calls through the
-    interpreter with their ACTUAL footprint captured and checked
-    against the prediction; a residue tx is a barrier — every earlier
-    tx's fee posts first (post_through), so it observes the exact
-    sequential state. Receipts, fees, and the cumulative block-gas
-    rule are applied strictly in index order regardless of execution
-    order; no predicted tx may touch the beneficiary (the planner
-    routes those to the residue), so deferring fee posting is
-    invisible.
+    the vectorized transfer executor, its TRUSTED templated calls
+    through the vectorized call executor (batch_call.py — the learner
+    promoted their code hash after TRUST_AFTER exact checked
+    confirmations), remaining template calls through the interpreter
+    with their ACTUAL footprint captured and checked against the
+    prediction (each successful checked run feeding LEARNER.confirm);
+    a residue tx is a barrier — every earlier tx's fee posts first
+    (post_through), so it observes the exact sequential state.
+    Receipts, fees, and the cumulative block-gas rule are applied
+    strictly in index order regardless of execution order; no
+    predicted tx may touch the beneficiary (the planner routes those
+    to the residue), so deferring fee posting is invisible.
+
+    Returns (world, receipts, gas_used, trusted_used) where
+    ``trusted_used`` is the set of code hashes whose calls executed
+    vectorized — execute_block's header-oracle backstop demotes them
+    all if the block root comes out wrong.
 
     Raises schedule.Misprediction or TxValidationError to demand the
     whole-block optimistic fallback (caller: execute_block).
     """
+    from khipu_tpu.ledger.batch_call import execute_call_batch
     from khipu_tpu.ledger.batch_exec import execute_fast_batch
     from khipu_tpu.ledger.schedule import (
         CALL,
         EMPTY_CODE_HASH,
+        EXEC_GAUGES,
         LEARNER,
         Misprediction,
+        Template,
+        _apply_rules,
+        _arg_words,
         footprint_ok,
         plan_block,
     )
@@ -503,6 +555,20 @@ def _execute_scheduled(
         txs, senders, header.beneficiary, merged.get_code_hash, LEARNER
     )
     stats.conflict_count += plan.conflicted
+    trusted_used: Set[bytes] = set()
+
+    # fused device validation for the gathered row tiles — only when
+    # the sync config opts in AND the PR 13 adaptive probe agrees the
+    # device round-trip pays for itself (host numpy is the default and
+    # the authoritative fallback either way)
+    device_validate = None
+    if sync_cfg is not None and getattr(sync_cfg, "exec_device", False):
+        from khipu_tpu.sync.adaptive import exec_device_allowed
+
+        if exec_device_allowed(sync_cfg):
+            from khipu_tpu.trie.fused import fused_exec_validate
+
+            device_validate = fused_exec_validate
 
     receipts: List[Receipt] = []
     outcomes: List[Optional[TxResult]] = [None] * len(txs)
@@ -588,18 +654,49 @@ def _execute_scheduled(
                 LEARNER.observe(
                     code_hash, senders[i], tx.to, tx.payload,
                     captured["reads"], captured["written"],
+                    code=merged.get_code(tx.to),
                 )
             post_through(i + 1)
             continue
         fast_items = []
+        call_items = []
         for i in step.indices:
             if plan.predicted[i].kind == CALL:
+                if i in plan.trusted:
+                    code_hash, tpl = plan.trusted[i]
+                    call_items.append(
+                        (i, txs[i], senders[i], code_hash, tpl)
+                    )
+                    continue
                 pred = plan.predicted[i]
-                code_hash = merged.get_code_hash(txs[i].tx.to)
+                tx_i = txs[i].tx
+                code_hash = merged.get_code_hash(tx_i.to)
+                # pre-state snapshot of every predicted slot, so a
+                # successful checked run can teach the learner this
+                # call's storage EFFECTS (toward the trusted lane)
+                confirm_keys = pre = original = None
+                tpl = LEARNER.lookup(code_hash)
+                if (isinstance(tpl, Template) and tpl.vectorizable
+                        and tpl.scan is not None and tx_i.value == 0):
+                    keys = _apply_rules(
+                        tpl.rules,
+                        int.from_bytes(senders[i], "big"),
+                        _arg_words(tx_i.payload),
+                    )
+                    if keys is not None:
+                        confirm_keys = keys
+                        pre = {
+                            k: merged.get_storage(tx_i.to, k)
+                            for k in keys
+                        }
+                        original = {
+                            k: merged.get_original_storage(tx_i.to, k)
+                            for k in keys
+                        }
                 _t0 = time.perf_counter()
                 captured = run_captured(i, 0)
-                # template calls run the interpreter too — same cost
-                # bucket as the residue (per-tx EVM time)
+                # checked template calls run the interpreter too —
+                # same cost bucket as the residue (per-tx EVM time)
                 LEDGER.record(
                     "exec.residue", HOST, 0,
                     duration=time.perf_counter() - _t0,
@@ -612,11 +709,45 @@ def _execute_scheduled(
                         i, "actual footprint escaped prediction"
                     )
                 stats.parallel_count += 1
+                EXEC_GAUGES["checked_call_txs"] += 1
+                if (confirm_keys is not None
+                        and outcomes[i].error is None
+                        and outcomes[i].status == 1):
+                    LEARNER.confirm(
+                        code_hash, senders[i], tx_i.payload,
+                        tx_i.value, config.fees,
+                        config.intrinsic_gas(tx_i.payload, False),
+                        tx_i.gas_limit, pre,
+                        {k: merged.get_storage(tx_i.to, k)
+                         for k in confirm_keys},
+                        original, outcomes[i].gas_used,
+                    )
             else:
                 fast_items.append((i, txs[i], senders[i]))
+        if call_items:
+            _t0 = time.perf_counter()
+            results = execute_call_batch(
+                config, merged, call_items,
+                device_validate=device_validate,
+            )
+            # vectorized templated-call time joins the transfer batch
+            # in the exec.batch cost bucket
+            LEDGER.record(
+                "exec.batch", HOST, 0,
+                duration=time.perf_counter() - _t0,
+            )
+            for (i, _, _, ch, _), r in zip(call_items, results):
+                outcomes[i] = r
+                trusted_used.add(ch)
+            stats.fast_path_txs += len(call_items)
+            stats.parallel_count += len(call_items)
+            EXEC_GAUGES["vector_call_txs"] += len(call_items)
         if fast_items:
             _t0 = time.perf_counter()
-            results = execute_fast_batch(config, merged, fast_items)
+            results = execute_fast_batch(
+                config, merged, fast_items,
+                device_validate=device_validate,
+            )
             # host-side classification event: vectorized fast-path
             # time per batch (joins with exec.residue for the execute
             # cost-model breakdown)
@@ -629,7 +760,7 @@ def _execute_scheduled(
             stats.fast_path_txs += len(fast_items)
             stats.parallel_count += len(fast_items)
     post_through(len(txs))
-    return merged, receipts, cumulative
+    return merged, receipts, cumulative, trusted_used
 
 
 def _run_one(

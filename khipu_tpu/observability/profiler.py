@@ -90,8 +90,11 @@ KNOWN_SITES = frozenset({
     "seal.upload", "seal.rootcheck", "seal.journal",
     # execute-stage sites (ISSUE 14 conflict-aware scheduler): the
     # vectorized fast-path batches vs the per-tx EVM residue, so
-    # ``bench --diff`` attributes execute-phase movement by site
-    "exec.batch", "exec.residue",
+    # ``bench --diff`` attributes execute-phase movement by site;
+    # exec.batch_device is the fused device validation of gathered
+    # account-row tiles (trie/fused.py, behind sync.exec_device + the
+    # adaptive probe)
+    "exec.batch", "exec.residue", "exec.batch_device",
     # sharded multi-device paths (parallel/)
     "shard.dispatch", "shard.gather", "shard.keccak", "shard.verify",
     # raw keccak ops (ops/)

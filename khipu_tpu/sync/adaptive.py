@@ -179,6 +179,21 @@ def probe_backend(margin: float = 1.5) -> ProbeResult:
     return result
 
 
+def exec_device_allowed(sync_cfg) -> bool:
+    """Gate for the execute-stage device dispatch (ledger/batch_*.py
+    -> trie/fused.fused_exec_validate): the sync config must opt in
+    (``exec_device``) AND the one-shot backend probe must show real
+    device memory — d2d beating host memcpy by the same margin the
+    adaptive commit controller demands. Where device memory is host
+    RAM (CPU jax), shipping row tiles out just adds a tunnel tax to a
+    numpy pass, so the probe keeps the host path authoritative."""
+    if not getattr(sync_cfg, "exec_device", False):
+        return False
+    if not getattr(sync_cfg, "adaptive_probe", True):
+        return True  # explicit cap with probing disabled: honor it
+    return probe_backend(sync_cfg.adaptive_d2d_margin).device_ok
+
+
 def _calibrate_host_hash_s(samples: int = 256) -> float:
     """Seconds per scalar host keccak — the host estimate the trigger
     compares against until measured host windows replace it."""

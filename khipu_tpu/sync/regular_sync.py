@@ -400,6 +400,7 @@ class RegularSyncService:
                 try:
                     done = self.reorg.switch(
                         ancestor_number, blocks,
+                        # khipu-lint: ok KL004 one-shot cached probe, no lock taken inside
                         import_fn=lambda b: self._import_healing(peer, b),
                     )
                 except ReorgTooDeep as e:
@@ -448,6 +449,7 @@ class RegularSyncService:
                     self.imported += done
                     blocks = blocks[done:]
             for block in blocks:
+                # khipu-lint: ok KL004 one-shot cached probe, no lock taken inside
                 self._import_healing(peer, block)
                 if self.txpool is not None:
                     self.txpool.remove_mined(block.body.transactions)
@@ -613,6 +615,7 @@ class RegularSyncService:
                 break
             try:
                 for block in blocks:
+                    # khipu-lint: ok KL004 one-shot cached probe, no lock taken inside
                     self._on_new_block_locked(block)
             finally:
                 self._import_lock.release()
@@ -632,6 +635,7 @@ class RegularSyncService:
         if not self._import_lock.acquire(blocking=False):
             return None
         try:
+            # khipu-lint: ok KL004 one-shot cached probe, no lock taken inside
             self._on_new_block_locked(block)
         finally:
             self._import_lock.release()

@@ -226,10 +226,13 @@ def _init_code(runtime):
 
 
 class TestScheduledOracleSweep:
-    def _random_chain(self, seed):
-        """Deploy the token, then one block of a seeded adversarial tx
-        mix: transfers (hot + disjoint), template calls, zero-value
-        touches, coinbase payments, creations."""
+    def _random_chain(self, seed, n_tx_blocks=4, txs_per_block=12):
+        """Deploy the token, then ``n_tx_blocks`` blocks of a seeded
+        adversarial tx mix: transfers (hot + disjoint), template calls,
+        zero-value touches, coinbase payments, creations. Multi-block
+        on purpose (ISSUE 17): the token calls must live long enough to
+        cross TRUST_AFTER confirmations so the later blocks' calls run
+        through the TRUSTED vectorized batch lane, not just checked."""
         rng = random.Random(seed)
         cfg = _cfg(parallel=False)
         builder = ChainBuilder(
@@ -242,37 +245,40 @@ class TestScheduledOracleSweep:
             coinbase=MINER,
         )]
         nonces = [1] + [0] * (NKEYS - 1)
-        txs = []
-        for _ in range(16):
-            i = rng.randrange(NKEYS)
-            r = rng.random()
-            if r < 0.30:
-                # hot transfers: few recipients, frequent sender reuse
-                txs.append(tx(i, nonces[i], rng.choice(ADDRS[:4]),
-                              1 + rng.randrange(50)))
-            elif r < 0.55:
-                payload = (
-                    ADDRS[rng.randrange(NKEYS)].rjust(32, b"\x00")
-                    + (1).to_bytes(32, "big")
-                )
-                txs.append(tx(i, nonces[i], token, 0, gas=200_000,
-                              payload=payload))
-            elif r < 0.65:
-                txs.append(tx(i, nonces[i], rng.choice(ADDRS), 0,
-                              gas=30_000))
-            elif r < 0.72:
-                txs.append(tx(i, nonces[i], MINER, 7))
-            elif r < 0.78:
-                txs.append(tx(i, nonces[i], None, 0, gas=60_000,
-                              payload=b"\x00"))
-            else:
-                txs.append(tx(
-                    i, nonces[i],
-                    bytes.fromhex("%040x" % (0xE0000000 + rng.randrange(8))),
-                    1 + rng.randrange(9),
-                ))
-            nonces[i] += 1
-        blocks.append(builder.add_block(txs, coinbase=MINER))
+        for _ in range(n_tx_blocks):
+            txs = []
+            for _ in range(txs_per_block):
+                i = rng.randrange(NKEYS)
+                r = rng.random()
+                if r < 0.30:
+                    # hot transfers: few recipients, frequent sender
+                    # reuse
+                    txs.append(tx(i, nonces[i], rng.choice(ADDRS[:4]),
+                                  1 + rng.randrange(50)))
+                elif r < 0.55:
+                    payload = (
+                        ADDRS[rng.randrange(NKEYS)].rjust(32, b"\x00")
+                        + (1 + rng.randrange(3)).to_bytes(32, "big")
+                    )
+                    txs.append(tx(i, nonces[i], token, 0, gas=200_000,
+                                  payload=payload))
+                elif r < 0.65:
+                    txs.append(tx(i, nonces[i], rng.choice(ADDRS), 0,
+                                  gas=30_000))
+                elif r < 0.72:
+                    txs.append(tx(i, nonces[i], MINER, 7))
+                elif r < 0.78:
+                    txs.append(tx(i, nonces[i], None, 0, gas=60_000,
+                                  payload=b"\x00"))
+                else:
+                    txs.append(tx(
+                        i, nonces[i],
+                        bytes.fromhex(
+                            "%040x" % (0xE0000000 + rng.randrange(8))),
+                        1 + rng.randrange(9),
+                    ))
+                nonces[i] += 1
+            blocks.append(builder.add_block(txs, coinbase=MINER))
         return blocks
 
     @pytest.mark.parametrize("bank", range(4))
@@ -282,8 +288,13 @@ class TestScheduledOracleSweep:
         gas all live in the sealed header; the replay validates
         against it and raises on any divergence), and so must the
         optimistic path. Templates reset between seeds — every seed
-        re-learns from its own residue."""
+        re-learns from its own residue, and the 4-block chains carry
+        the token past TRUST_AFTER so the trusted vectorized call lane
+        executes real traffic inside the sweep."""
+        from khipu_tpu.ledger.schedule import EXEC_GAUGES
+
         total_fast = total_residue = 0
+        vector_before = EXEC_GAUGES["vector_call_txs"]
         for seed in range(bank * 30, bank * 30 + 30):
             blocks = self._random_chain(seed)
             reset_templates()
@@ -291,14 +302,17 @@ class TestScheduledOracleSweep:
                 bc = _fresh(cfg)
                 stats = ReplayDriver(bc, cfg).replay(blocks)
                 assert (
-                    bc.get_header_by_number(2).hash == blocks[-1].hash
+                    bc.get_header_by_number(len(blocks)).hash
+                    == blocks[-1].hash
                 ), f"seed {seed} diverged (scheduled="\
                    f"{cfg.sync.scheduled_tx})"
                 if cfg.sync.scheduled_tx:
                     total_fast += stats.fast_path_txs
                     total_residue += stats.residue_txs
-        # the sweep must actually exercise both executors
+        # the sweep must actually exercise both executors AND the
+        # trusted templated-call lane (not just checked calls)
         assert total_fast > 0 and total_residue > 0
+        assert EXEC_GAUGES["vector_call_txs"] > vector_before
 
     def test_template_call_batches_after_learning(self):
         """Same-shaped token calls: the first call runs residue (and
@@ -410,6 +424,296 @@ class TestMispredictionFallback:
         stats2 = ReplayDriver(bc2, cfg).replay(blocks)
         assert bc2.get_header_by_number(3).hash == blocks[-1].hash
         assert stats2.mispredictions == 0
+
+
+# ------------------------------------------- mapping-slot templates
+
+
+# ERC-20 transfer(to, amount) with real keccak mapping slots: balances
+# at keccak(pad32(holder) ++ pad32(0)); calldata is the raw two words
+# (arg0 = recipient, arg1 = amount). Straight-line + whitelisted, so
+# the purity scan passes and the learner can trust it after
+# confirmation (ISSUE 17)
+_ERC20_RUNTIME = bytes([
+    0x33, 0x60, 0x00, 0x52,              # mem[0:32] = caller
+    0x60, 0x00, 0x60, 0x20, 0x52,        # mem[32:64] = 0 (base slot)
+    0x60, 0x40, 0x60, 0x00, 0x20,        # sender slot = SHA3(0, 64)
+    0x80, 0x54,                          # sender balance
+    0x60, 0x20, 0x35, 0x90, 0x03,        # bal - amount
+    0x90, 0x55,                          # debit sender
+    0x60, 0x00, 0x35, 0x60, 0x00, 0x52,  # mem[0:32] = recipient
+    0x60, 0x40, 0x60, 0x00, 0x20,        # recipient slot = SHA3(0, 64)
+    0x80, 0x54,                          # recipient balance
+    0x60, 0x20, 0x35, 0x01,              # bal + amount
+    0x90, 0x55,                          # credit recipient
+    0x00,                                # STOP
+])
+
+
+def _codecopy_init(runtime):
+    """Constructor for runtimes wider than one PUSH word."""
+    return bytes([
+        0x60, len(runtime), 0x60, 0x0C, 0x60, 0x00, 0x39,  # CODECOPY
+        0x60, len(runtime), 0x60, 0x00, 0xF3,              # RETURN
+    ]) + runtime
+
+
+class TestMappingTemplates:
+    def _erc20_chain(self, n_call_blocks):
+        """Deploy the ERC-20, then ``n_call_blocks`` blocks of two
+        disjoint transfer(to, amount) calls each plus a filler
+        transfer (single-tx blocks take the sequential path and would
+        teach nothing)."""
+        seq = _cfg(parallel=False)
+        builder = ChainBuilder(
+            Blockchain(Storages(), seq), seq, GenesisSpec(alloc=ALLOC)
+        )
+        token = contract_address(ADDRS[0], 0)
+
+        def call(i, nonce, rcpt, amount):
+            return tx(
+                i, nonce, token, 0, gas=200_000,
+                payload=rcpt.rjust(32, b"\x00")
+                + amount.to_bytes(32, "big"),
+            )
+
+        blocks = [builder.add_block(
+            [tx(0, 0, None, 0, gas=500_000,
+                payload=_codecopy_init(_ERC20_RUNTIME)),
+             tx(4, 0, ADDRS[10], 3)],
+            coinbase=MINER,
+        )]
+        nonces = [1] + [0] * (NKEYS - 1)
+        nonces[4] = 1
+        holders = [
+            bytes.fromhex("%040x" % (0xE20E2000 + i)) for i in range(8)
+        ]
+        for n in range(n_call_blocks):
+            s1, s2, filler = 1 + (n % 3), 5 + (n % 3), 8 + (n % 4)
+            txs = [
+                call(s1, nonces[s1], holders[n % 8], 100 + 7 * n),
+                call(s2, nonces[s2], holders[(n + 3) % 8], 5 + n),
+                tx(filler, nonces[filler], ADDRS[11], 2 + n),
+            ]
+            for i in (s1, s2, filler):
+                nonces[i] += 1
+            blocks.append(builder.add_block(txs, coinbase=MINER))
+        return blocks, token
+
+    def test_mapping_rules_promote_after_one_observation(self):
+        """One observed call is enough to derive BOTH mapping-form
+        write rules — debit keccak(caller || 0), credit
+        keccak(arg0 || 0) — with the arg-delta effect shapes. No
+        second observation, no confirmation required for the template
+        (trust comes later; the template itself must exist now)."""
+        from khipu_tpu.ledger.schedule import TRUST_AFTER
+
+        blocks, token = self._erc20_chain(1)
+        reset_templates()
+        cfg = _cfg()
+        bc = _fresh(cfg)
+        ReplayDriver(bc, cfg).replay(blocks)
+        assert bc.get_header_by_number(len(blocks)).hash == blocks[-1].hash
+        code_hash = bc.get_world_state(
+            blocks[0].header.state_root
+        ).get_code_hash(token)
+        verdict = LEARNER.lookup(code_hash)
+        assert verdict is not None and verdict != "opaque"
+        assert ("map_caller", 0) in verdict.rules
+        assert ("map_arg", 0, 0) in verdict.rules
+        assert ("map_caller", 0) in verdict.write_rules
+        assert ("map_arg", 0, 0) in verdict.write_rules
+        # the purity scan accepted the runtime, but one observation is
+        # NOT trust: effects only exist after checked confirmations,
+        # and the vectorized lane further needs TRUST_AFTER of them
+        assert verdict.scan is not None
+        assert verdict.effects is None
+        assert verdict.confirmations < TRUST_AFTER
+
+    def test_trusted_mapping_calls_run_vectorized_bit_exact(self):
+        """Past TRUST_AFTER checked confirmations the mapping calls
+        execute in the trusted vectorized batch lane — visible in the
+        vector_call_txs gauge — and the replay still lands on the
+        serial fold's exact headers."""
+        from khipu_tpu.ledger.schedule import EXEC_GAUGES, TRUST_AFTER
+
+        blocks, token = self._erc20_chain(6)
+        reset_templates()
+        cfg = _cfg()
+        bc = _fresh(cfg)
+        before = EXEC_GAUGES["vector_call_txs"]
+        stats = ReplayDriver(bc, cfg).replay(blocks)
+        assert bc.get_header_by_number(len(blocks)).hash == blocks[-1].hash
+        assert stats.mispredictions == 0
+        # blocks 2..1+TRUST_AFTER run checked; the remaining call
+        # blocks (2 calls each) run trusted
+        expect_vector = 2 * (6 - 1 - TRUST_AFTER)
+        assert EXEC_GAUGES["vector_call_txs"] - before >= expect_vector
+        code_hash = bc.get_world_state(
+            blocks[0].header.state_root
+        ).get_code_hash(token)
+        verdict = LEARNER.lookup(code_hash)
+        assert verdict.confirmations >= TRUST_AFTER
+        assert verdict.vectorizable
+        # learned effects: debit is old - arg1, credit is old + arg1
+        by_rule = dict(zip(verdict.write_rules, verdict.effects))
+        assert by_rule[("map_caller", 0)][0] == ("old_sub_arg", 1)
+        assert by_rule[("map_arg", 0, 0)][0] == ("old_add_arg", 1)
+
+    # poisoned mapping: SSTORE(keccak(pad32(caller) ++ pad32(arg1)),
+    # arg0) — with arg1=0 the learner derives ("map_caller", 0); a
+    # later call with arg1 != 0 writes a DIFFERENT mapping bucket than
+    # predicted -> footprint escape -> fallback + permanent demotion
+    POISON_RUNTIME = bytes([
+        0x33, 0x60, 0x00, 0x52,        # mem[0:32] = caller
+        0x60, 0x20, 0x35,              # arg1 (base slot, attacker's)
+        0x60, 0x20, 0x52,              # mem[32:64] = arg1
+        0x60, 0x40, 0x60, 0x00, 0x20,  # slot = SHA3(0, 64)
+        0x60, 0x00, 0x35,              # arg0 (value)
+        0x90, 0x55,                    # SSTORE(slot, arg0)
+        0x00,
+    ])
+
+    def test_poisoned_mapping_slot_demotes_bit_exact(self):
+        """The mapping analog of the XOR misprediction test: the
+        derived ("map_caller", 0) rule is a lie the learner cannot see
+        from one observation. The poisoned call must fall back
+        whole-block (bit-exact), demote the code hash to opaque, and a
+        re-run must take the residue path with no second fallback."""
+        cfg = _cfg()
+        seq = _cfg(parallel=False)
+        builder = ChainBuilder(
+            Blockchain(Storages(), seq), seq, GenesisSpec(alloc=ALLOC)
+        )
+        poison = contract_address(ADDRS[0], 0)
+
+        def call(i, nonce, a0, a1):
+            return tx(
+                i, nonce, poison, 0, gas=100_000,
+                payload=a0.to_bytes(32, "big") + a1.to_bytes(32, "big"),
+            )
+
+        blocks = [
+            builder.add_block(
+                [tx(0, 0, None, 0, gas=500_000,
+                    payload=_init_code(self.POISON_RUNTIME)),
+                 tx(4, 0, ADDRS[10], 3)],
+                coinbase=MINER,
+            ),
+            # learning call: arg1=0 -> slot == keccak(caller || 0)
+            builder.add_block(
+                [call(1, 0, 0x99, 0), tx(5, 0, ADDRS[10], 3)],
+                coinbase=MINER,
+            ),
+            # poisoned call: arg1=3 writes keccak(caller || 3), the
+            # prediction says keccak(caller || 0)
+            builder.add_block(
+                [call(2, 0, 7, 3), tx(3, 0, ADDRS[8], 9)],
+                coinbase=MINER,
+            ),
+        ]
+        reset_templates()
+        bc = _fresh(cfg)
+        stats = ReplayDriver(bc, cfg).replay(blocks)
+        assert bc.get_header_by_number(3).hash == blocks[-1].hash
+        assert stats.mispredictions >= 1
+        code_hash = bc.get_world_state(
+            blocks[0].header.state_root
+        ).get_code_hash(poison)
+        assert LEARNER.lookup(code_hash) == "opaque"
+        bc2 = _fresh(cfg)
+        stats2 = ReplayDriver(bc2, cfg).replay(blocks)
+        assert bc2.get_header_by_number(3).hash == blocks[-1].hash
+        assert stats2.mispredictions == 0
+
+    def test_demotion_is_permanent(self):
+        """Opaque is forever: once demoted, no stream of perfectly
+        consistent observations may resurrect the template — the
+        promote/demote protocol must not oscillate."""
+        from khipu_tpu.native.keccak import keccak256_batch
+
+        token = b"\x70" * 20
+        code_hash = b"\x73" * 32
+        learner = TemplateLearner()
+        sender = ADDRS[1]
+        slot = int.from_bytes(keccak256_batch(
+            [sender.rjust(32, b"\x00") + b"\x00" * 32]
+        )[0], "big")
+        footprint = dict(
+            reads={ON_ACCOUNT: {sender, token}, ON_ADDRESS: set(),
+                   ON_STORAGE: {(token, slot)}, ON_CODE: {token}},
+            written={ON_ACCOUNT: {sender}, ON_ADDRESS: set(),
+                     ON_STORAGE: {(token, slot)}, ON_CODE: set()},
+        )
+        payload = (5).to_bytes(32, "big")
+        learner.observe(code_hash, sender, token, payload, **footprint)
+        assert learner.lookup(code_hash) != "opaque"
+        learner.demote(code_hash)
+        assert learner.lookup(code_hash) == "opaque"
+        for _ in range(5):
+            learner.observe(code_hash, sender, token, payload,
+                            **footprint)
+            assert learner.lookup(code_hash) == "opaque"
+
+    def test_concurrent_observation_determinism(self):
+        """Racing observers must converge on the SAME template a
+        serial pass derives, for every interleaving — the learner is
+        shared across executor threads and a rule set that depended on
+        arrival order would make replay nondeterministic."""
+        import threading
+
+        from khipu_tpu.native.keccak import keccak256_batch
+
+        token = b"\x70" * 20
+        code_hash = b"\x74" * 32
+
+        def observation(i):
+            sender = ADDRS[i]
+            rcpt = ADDRS[(i + 5) % NKEYS]
+            amount = 3 + i
+            pre = [sender.rjust(32, b"\x00") + b"\x00" * 32,
+                   rcpt.rjust(32, b"\x00") + b"\x00" * 32]
+            ss, rs = [
+                int.from_bytes(k, "big") for k in keccak256_batch(pre)
+            ]
+            payload = (rcpt.rjust(32, b"\x00")
+                       + amount.to_bytes(32, "big"))
+            return sender, payload, dict(
+                reads={ON_ACCOUNT: {sender, token}, ON_ADDRESS: set(),
+                       ON_STORAGE: {(token, ss), (token, rs)},
+                       ON_CODE: {token}},
+                written={ON_ACCOUNT: {sender}, ON_ADDRESS: set(),
+                         ON_STORAGE: {(token, ss), (token, rs)},
+                         ON_CODE: set()},
+            )
+
+        obs = [observation(i) for i in range(NKEYS)]
+        serial = TemplateLearner()
+        for sender, payload, fp in obs:
+            serial.observe(code_hash, sender, token, payload, **fp)
+        ref = serial.lookup(code_hash)
+        assert ref != "opaque" and ("map_caller", 0) in ref.rules
+        for trial in range(8):
+            rng = random.Random(trial)
+            learner = TemplateLearner()
+            order = list(obs)
+            rng.shuffle(order)
+            threads = [
+                threading.Thread(
+                    target=lambda o=o: learner.observe(
+                        code_hash, o[0], token, o[1], **o[2]
+                    )
+                )
+                for o in order
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            got = learner.lookup(code_hash)
+            assert got != "opaque", f"trial {trial} went opaque"
+            assert got.rules == ref.rules, f"trial {trial} diverged"
+            assert got.write_rules == ref.write_rules
 
 
 # ------------------------------------------------ sender prefetch cache
