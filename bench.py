@@ -2156,6 +2156,429 @@ def bench_serve(smoke=False):
     )
 
 
+def _fleet_setup(n_blocks, txs_per_block=4):
+    """Primary + fork branch + 2 read replicas + FleetRouter, wired
+    for ``bench.py --serve --http``.
+
+    The fixture chain is shaped so the loadgen's monotone RYW checker
+    stays SOUND across the mid-run reorg: blocks up to the fork
+    ancestor move the checked senders/receivers, the diverged suffix
+    (both branches) only touches a disjoint sender/receiver set. A
+    reorg legitimately rewinds suffix state to the ancestor — but the
+    checked addresses are identical at every height >= ancestor on
+    both branches, so any regression the checker reports is a REAL
+    stale read (a replica serving below a token floor), never reorg
+    semantics."""
+    import dataclasses
+
+    from khipu_tpu.config import (
+        ServingConfig,
+        SyncConfig,
+        TelemetryConfig,
+        fixture_config,
+    )
+    from khipu_tpu.domain.block import Block as _Block
+    from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+    from khipu_tpu.domain.transaction import Transaction, sign_transaction
+    from khipu_tpu.jsonrpc import EthService, JsonRpcServer
+    from khipu_tpu.observability.telemetry import ClusterTelemetry
+    from khipu_tpu.serving import AdmissionController, ReadView, ServingPlane
+    from khipu_tpu.serving.admission import (
+        journal_pressure,
+        pipeline_pressure,
+        txpool_pressure,
+    )
+    from khipu_tpu.serving.fleet import FleetRouter
+    from khipu_tpu.serving.replica import PrimaryFeed, ReplicaDriver
+    from khipu_tpu.storage.storages import Storages
+    from khipu_tpu.sync.chain_builder import ChainBuilder
+    from khipu_tpu.sync.reorg import ReorgManager
+    from khipu_tpu.txpool import PendingTransactionsPool
+
+    serve_cfg = ServingConfig(queue_timeout=0.004, max_queue=4)
+    cfg = dataclasses.replace(
+        fixture_config(chain_id=1),
+        sync=SyncConfig(parallel_tx=False, commit_window_blocks=1),
+        serving=serve_cfg,
+    )
+    nsenders = 8
+    keys, addrs = _replay_keys(nsenders)
+    checked_receivers = [
+        bytes.fromhex("%040x" % (0xFEED0000 + i)) for i in range(16)
+    ]
+    suffix_receivers = [
+        bytes.fromhex("%040x" % (0xD00D0000 + i)) for i in range(16)
+    ]
+    alloc = {a: 10**24 for a in addrs}
+    genesis = GenesisSpec(alloc=alloc)
+    ancestor = n_blocks - 2  # both branches share blocks 1..ancestor
+
+    def build(total, value_off, suffix_coinbase):
+        builder = ChainBuilder(
+            Blockchain(Storages(), cfg), cfg, genesis
+        )
+        blocks, nonces = [], [0] * nsenders
+        for n in range(total):
+            diverged = n >= ancestor
+            txs = []
+            for j in range(txs_per_block):
+                # checked half of the key/receiver space drives the
+                # shared prefix; the disjoint half drives the suffix
+                i = (4 + j % 4) if diverged else (j % 4)
+                to_pool = (
+                    suffix_receivers if diverged else checked_receivers
+                )
+                txs.append(sign_transaction(
+                    Transaction(
+                        nonces[i], 10**9, 21_000,
+                        to_pool[(j * 7 + n) % len(to_pool)],
+                        1_000 + n + (value_off if diverged else 0),
+                    ),
+                    keys[i], chain_id=1,
+                ))
+                nonces[i] += 1
+            blocks.append(builder.add_block(
+                txs,
+                coinbase=suffix_coinbase if diverged else b"\xaa" * 20,
+                timestamp=10 * (n + 1),
+            ))
+        return blocks
+
+    base = build(n_blocks, 0, b"\xaa" * 20)
+    fork = build(n_blocks + 2, 10**6, b"\xbb" * 20)
+    wire = [_Block.decode(b.encode()) for b in base]
+    fork_wire = [_Block.decode(b.encode()) for b in fork]
+
+    target = Blockchain(Storages(), cfg)
+    target.load_genesis(genesis)
+    # tiny pool: the overload phases' write fraction fills it early,
+    # pinning txpool_pressure at 1.0 — past shed_read_at, so a SINGLE
+    # driver sheds its read classes too. That pressure isolation is
+    # the fleet's whole value: replicas don't share the primary's
+    # pressure signals, so reads keep flowing
+    pool = PendingTransactionsPool(capacity=24)
+    read_view = ReadView(target)
+    # bench-scaled HARD: one driver's whole read-side capacity is 4
+    # in-flight (2 cheap + 2 read). That is the denominator of the
+    # fleet-vs-solo gate — the replicas run the production
+    # DEFAULT_LIMITS, which is the capacity the fleet adds
+    admission = AdmissionController(
+        serve_cfg,
+        limits={"cheap": 2, "read": 2, "execute": 2, "write": 2},
+        signals=[
+            pipeline_pressure(),
+            journal_pressure(target.storages, 2),
+            txpool_pressure(pool),
+        ],
+    )
+    plane = ServingPlane(serve_cfg, read_view=read_view,
+                         admission=admission)
+    service = EthService(
+        target, cfg, pool, read_view=read_view, serving=plane,
+    )
+    from khipu_tpu.sync.replay import ReplayDriver
+
+    driver = ReplayDriver(target, cfg, read_view=read_view)
+    reorg = ReorgManager(
+        target, cfg, driver=driver, read_view=read_view
+    )
+    reorg.add_listener(service._filter_manager.note_reorg)
+    server = JsonRpcServer(service, serving=plane)
+
+    feed = PrimaryFeed(target)
+    replicas = [
+        ReplicaDriver(f"r{i}", feed, cfg, genesis).start()
+        for i in (1, 2)
+    ]
+    # replicas ARE the scrape clients: a killed replica fails its
+    # scrape and khipu_shard_health drops to 0.0 — the health signal
+    # the router's pick-2 consumes
+    by_name = {r.name: r for r in replicas}
+    telemetry = ClusterTelemetry(
+        list(by_name),
+        config=TelemetryConfig(
+            enabled=True, scrape_interval=0.2, staleness_s=5.0
+        ),
+        client_factory=lambda ep: by_name[ep],
+    )
+    router = FleetRouter(
+        server, replicas, telemetry=telemetry, reorg_manager=reorg,
+    )
+    return (cfg, target, wire, fork_wire, ancestor, addrs,
+            checked_receivers, plane, service, server, driver, reorg,
+            replicas, telemetry, router)
+
+
+def bench_serve_http(smoke=False):
+    """``bench.py --serve --http``: the replica-fleet bench over the
+    REAL wire path — keep-alive HTTP into a FleetRouter fronting a
+    primary plus two read replicas, with the read-your-writes checker
+    (consistent-read tokens) on the whole time. Three phases: (A)
+    unloaded floor over HTTP, (B) a 4x MIXED overload against the
+    primary ALONE while a pinned ``primary_distress`` pressure signal
+    models the node states PR 10/13 pin to 1.0 (failed scrapes,
+    journal runaway) — past ``shed_read_at``, the single driver sheds
+    its read classes along with writes and only cheap survives, (C)
+    the SAME offered load and the SAME distress through the fleet,
+    during which one replica is KILLED mid-phase and the primary
+    REORGS under the load (the survivor must mirror the switch;
+    tokens anchored to retracted blocks re-anchor to the fork
+    ancestor). The gate: at equal offered load and an equal-or-better
+    admitted p99, the fleet completes >=2x the requests the solo
+    driver does — replicas do NOT share the primary's pressure
+    signals, so primary distress cannot take the read plane down with
+    it. That pressure isolation is the capacity a read-replica fleet
+    actually adds (full mode; smoke pins mechanics + exposition
+    instead)."""
+    import threading
+
+    from khipu_tpu.serving.loadgen import (
+        MIXED,
+        READ_ONLY,
+        HttpTransport,
+        LoadGenerator,
+    )
+    from khipu_tpu.serving.router import ReadToken
+
+    n_blocks = 10 if smoke else 48
+    (cfg, target, wire, fork_wire, ancestor, addrs, receivers, plane,
+     service, server, driver, reorg, replicas, telemetry,
+     router) = _fleet_setup(n_blocks)
+    port = router.start_http()
+    url = f"http://127.0.0.1:{port}/"
+    nonce_addrs = ["0x" + a.hex() for a in addrs[:4]]
+    balance_addrs = ["0x" + r.hex() for r in receivers]
+
+    def gen(transport, profile, clients, reqs, seed, key_base):
+        return LoadGenerator(
+            transport, profile, clients=clients, seed=seed,
+            max_requests=reqs,
+            nonce_addresses=nonce_addrs,
+            balance_addresses=balance_addrs,
+            client_keys=[
+                (key_base + i).to_bytes(32, "big")
+                for i in range(clients)
+            ],
+            chain_id=1,
+        )
+
+    # background import throttled to span the load phases: replicas
+    # tail the committed chain WHILE clients read through the router,
+    # so token floors are live (a replica can genuinely be behind)
+    delay = 0.01 if smoke else 0.03
+    sync_done = threading.Event()
+
+    def run_sync():
+        import time as _t
+
+        try:
+            for b in wire:
+                stats = driver.replay([b])
+                _t.sleep(delay)
+        finally:
+            sync_done.set()
+
+    sync_thread = threading.Thread(target=run_sync, daemon=True)
+    sync_thread.start()
+
+    # phase A: unloaded floor over the wire (keep-alive path)
+    floor_t = HttpTransport(url)
+    floor = gen(floor_t, READ_ONLY, 2, 30 if smoke else 150, 11,
+                0x0A11_0000).run()
+    p99_floor = floor.p99()
+
+    # phase B (full mode): the 4x MIXED overload against the primary
+    # alone, on its own HTTP front, under pinned primary distress.
+    # The txpool alone cannot push pressure past shed_read_at — its
+    # sheds self-limit at the write threshold (writes stop feeding the
+    # pool, the fill freezes below 0.95: reads-survive-writes-shed is
+    # the admission plane working). Distress models the states the
+    # observability plane pins to 1.0 — a failed shard scrape, a
+    # journal runaway — where a SINGLE driver has no choice but to
+    # shed reads too
+    over_clients = 8 if smoke else 32
+    over_reqs = 25 if smoke else 40
+    solo = None
+
+    def primary_distress():
+        return 1.0
+
+    primary_distress.signal_name = "primary_distress"
+    if not smoke:
+        plane.admission.add_signal(primary_distress)
+        solo_port = server.start()
+        solo_t = HttpTransport(f"http://127.0.0.1:{solo_port}/")
+        solo = gen(solo_t, MIXED, over_clients, over_reqs, 33,
+                   0x0C33_0000).run()
+        server.stop()
+
+    # phase C: the SAME offered load and the SAME distress through
+    # the fleet; one replica dies mid-phase (this is the
+    # latency-gated window — failover must not cost the admitted tail
+    # its budget)
+    kill_timer = threading.Timer(
+        0.3 if smoke else 1.0, replicas[0].kill
+    )
+    kill_timer.start()
+    over_t = HttpTransport(url)
+    overload = gen(over_t, MIXED, over_clients, over_reqs, 22,
+                   0x0B22_0000).run()
+    if primary_distress in plane.admission.signals:
+        plane.admission.signals.remove(primary_distress)
+    kill_timer.cancel()
+    if replicas[0].alive():  # tiny smoke runs can beat the timer
+        replicas[0].kill()
+    sync_thread.join(timeout=120)
+
+    # phase D: the primary switches to the longer fork branch UNDER
+    # live token-bearing traffic. The switch (and each replica's
+    # mirrored switch) re-executes the adopted suffix — a real CPU
+    # burst, so this phase checks CONSISTENCY (zero RYW violations
+    # across the retraction), not tail latency
+    reorged = threading.Event()
+
+    def run_reorg():
+        reorg.switch(ancestor, fork_wire[ancestor:])
+        reorged.set()
+
+    reorg_thread = threading.Thread(target=run_reorg, daemon=True)
+    ryw_t = HttpTransport(url)
+    ryw_gen = gen(ryw_t, READ_ONLY, 2 if smoke else 4,
+                  15 if smoke else 40, 44, 0x0D44_0000)
+    reorg_thread.start()
+    ryw = ryw_gen.run()
+    reorg_thread.join(timeout=120)
+    assert reorged.is_set(), "fork switch never ran"
+
+    # the survivor must mirror the primary's switch and converge on
+    # the adopted branch tip
+    deadline = time.perf_counter() + 30
+    fork_tip = len(fork_wire)
+    while (time.perf_counter() < deadline
+           and replicas[1].head_number() < fork_tip):
+        time.sleep(0.02)
+    assert replicas[1].head_number() == fork_tip, replicas[1].snapshot()
+    assert replicas[1].switches_mirrored >= 1, replicas[1].snapshot()
+    assert not replicas[0].alive()
+
+    # a token anchored to a RETRACTED block must re-anchor, and an
+    # unservable floor must redirect to the primary — both counted
+    stale = ReadToken(1, ancestor + 1,
+                      wire[ancestor].header.hash).encode()
+    resp = over_t.call("eth_blockNumber", [], token=stale)
+    assert "result" in resp, resp
+    assert router.tokens_reanchored >= 1, router.snapshot()
+    before = router.ryw_redirects
+    future = ReadToken(1, fork_tip + 10_000, None).encode()
+    resp = over_t.call("eth_blockNumber", [], token=future)
+    assert resp["result"] == hex(fork_tip), resp
+    assert router.ryw_redirects > before, router.snapshot()
+
+    # dead replica = failed scrape = health 0.0 (what pick-2 consumes)
+    telemetry.scrape_once()
+    scores = telemetry.health_scores()
+    assert scores[replicas[0].name].score == 0.0, scores
+    assert scores[replicas[1].name].score > 0.0, scores
+
+    violations = (
+        len(floor.violations) + len(overload.violations)
+        + len(ryw.violations)
+    )
+    if solo is not None:
+        violations += len(solo.violations)
+    assert violations == 0, (
+        floor.violations + overload.violations + ryw.violations
+        + (solo.violations if solo is not None else [])
+    )[:5]
+    overhead = overload.transport_overhead or {}
+
+    if smoke:
+        # exposition: every fleet family exactly once
+        text = service.khipu_metrics_text()
+        for fam, kind in (
+            ("khipu_fleet_reads_per_sec", "gauge"),
+            ("khipu_fleet_requests_total", "counter"),
+            ("khipu_fleet_ryw_redirects_total", "counter"),
+            ("khipu_fleet_tokens_reanchored_total", "counter"),
+            ("khipu_replica_lag_blocks", "gauge"),
+        ):
+            n = text.count(f"# TYPE {fam} {kind}")
+            assert n == 1, f"{fam} TYPE lines: {n}"
+        router.stop_http()
+        emit(
+            "fleet_serve_smoke",
+            floor.requests + overload.requests + ryw.requests,
+            "requests",
+            ryw_violations=violations,
+            ryw_redirects=router.ryw_redirects,
+            tokens_reanchored=router.tokens_reanchored,
+            replica_kill_ok=True,
+            switch_mirrored=replicas[1].switches_mirrored,
+            transport_overhead_p50_ms=overhead.get("p50Ms"),
+            reconnects=overhead.get("reconnects"),
+            exposition_families_ok=True,
+        )
+        return
+
+    # the capacity gate: equal offered load, equal-or-better admitted
+    # p99 — the fleet must COMPLETE >=2x what the pressure-shedding
+    # solo driver did (replicas don't share the primary's pressure
+    # signals, so the saturated write plane can't shed the reads)
+    fleet_qps = overload.ok / overload.seconds
+    fleet_p99 = overload.p99()
+    solo_qps = solo.ok / solo.seconds if solo.seconds else 0.0
+    assert solo.shed > 0, "solo driver never shed under 4x overload"
+    assert overload.ok >= 2 * solo.ok, (
+        f"fleet completed {overload.ok}/{overload.requests} vs solo "
+        f"{solo.ok}/{solo.requests} at equal offered load — "
+        f"expected >=2x"
+    )
+    assert fleet_p99 <= max(solo.p99(), 5 * p99_floor), (
+        f"fleet p99 {fleet_p99 * 1e3:.3f}ms worse than solo "
+        f"{solo.p99() * 1e3:.3f}ms and 5x floor"
+    )
+    router.stop_http()
+    max_lag = max(r.lag_blocks() for r in replicas[1:])
+    emit(
+        "fleet_reads_per_sec", round(router.reads_per_sec(), 1),
+        "req/s",
+        fleet_completed=overload.ok,
+        solo_completed=solo.ok,
+        fleet_vs_solo=round(overload.ok / solo.ok, 2) if solo.ok else 0,
+        fleet_admitted_qps=round(fleet_qps, 1),
+        solo_admitted_qps=round(solo_qps, 1),
+        fleet_shed_rate=round(overload.shed_rate, 4),
+        solo_shed_rate=round(solo.shed_rate, 4),
+        fleet_p99_ms=round(fleet_p99 * 1e3, 3),
+        solo_p99_ms=round(solo.p99() * 1e3, 3),
+        p99_floor_ms=round(p99_floor * 1e3, 3),
+        ryw_violations=violations,
+        note="equal 4x MIXED overload over keep-alive HTTP under "
+             "pinned primary distress; the fleet phase rode a replica "
+             "kill, and the reorg-under-traffic phase held zero RYW "
+             "violations with tokens on",
+    )
+    emit(
+        "replica_lag_blocks", max_lag, "blocks",
+        survivor_head=replicas[1].head_number(),
+        switches_mirrored=replicas[1].switches_mirrored,
+    )
+    emit(
+        "ryw_redirects_total", router.ryw_redirects, "redirects",
+        tokens_reanchored=router.tokens_reanchored,
+        reads_replica=router.reads_replica,
+        reads_primary=router.reads_primary,
+    )
+    emit(
+        "transport_overhead_ms", overhead.get("p50Ms", 0.0), "ms",
+        p99_ms=overhead.get("p99Ms"),
+        samples=overhead.get("samples"),
+        reconnects=overhead.get("reconnects"),
+        note="wall minus X-Khipu-Served-Ms on the persistent "
+             "keep-alive connections",
+    )
+
+
 def bench_rebalance(smoke=False, deadline_s=120.0):
     """``bench.py --rebalance``: elastic-membership smoke/bench — a
     3-shard in-process cluster takes a 4th shard through the full
@@ -2795,7 +3218,10 @@ def bench_ingest(smoke=False, deadline_s=180.0):
 
 def main() -> None:
     if "--serve" in sys.argv:
-        bench_serve(smoke="--smoke" in sys.argv)
+        if "--http" in sys.argv:
+            bench_serve_http(smoke="--smoke" in sys.argv)
+        else:
+            bench_serve(smoke="--smoke" in sys.argv)
         return
     if "--rebalance" in sys.argv:
         bench_rebalance(smoke="--smoke" in sys.argv)

@@ -303,6 +303,21 @@ class ServingConfig:
     # SLO objective: fraction of requests that must be admitted and
     # answered without an internal error (error-budget readout)
     objective: float = 0.999
+    # replica fleet (serving/replica.py + serving/fleet.py —
+    # docs/serving.md "Replica fleet"): a follower past this many
+    # committed blocks behind the primary saturates the replica_lag
+    # pressure signal, so its read class sheds instead of serving
+    # stale state
+    max_replica_lag_blocks: int = 16
+    # consistent-read wait-or-redirect budget: a token-bearing read
+    # waits at most this long for the picked replica's tail to reach
+    # the token height before redirecting to the primary
+    ryw_wait_s: float = 0.05
+    # follower tail pacing: idle poll interval and the per-pass block
+    # batch bound (a far-behind replica catches up in bounded slices
+    # so lag stays an honest signal)
+    replica_poll_interval: float = 0.02
+    replica_batch_blocks: int = 64
 
 
 @dataclass(frozen=True)

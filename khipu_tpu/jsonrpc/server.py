@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
@@ -134,6 +135,16 @@ class JsonRpcServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # keep-alive: HTTP/1.1 defaults to persistent connections,
+            # so a pooled client (loadgen.HttpTransport, any real SDK)
+            # pays the TCP handshake once per worker instead of once
+            # per request. The contract that makes this safe is that
+            # EVERY response path below sends an exact Content-Length
+            # — shed (-32005) and parse errors ride the normal path,
+            # and the oversized-body refusal explicitly closes (the
+            # unread body makes the stream unresyncable).
+            protocol_version = "HTTP/1.1"
+
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 if length > outer.max_body_bytes:
@@ -160,6 +171,7 @@ class JsonRpcServer:
                     self.wfile.write(payload)
                     return
                 body = self.rfile.read(length)
+                t0 = time.perf_counter()
                 try:
                     request = json.loads(body)
                     response = outer.handle(
@@ -172,11 +184,18 @@ class JsonRpcServer:
                         "jsonrpc": "2.0", "id": None,
                         "error": {"code": -32700, "message": "parse error"},
                     }
+                served_ms = (time.perf_counter() - t0) * 1e3
                 payload = json.dumps(response).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Access-Control-Allow-Origin", "*")
                 self.send_header("Content-Length", str(len(payload)))
+                # server-side dispatch time, so a pooled client can
+                # subtract it from wall time and report the transport
+                # overhead as its own number (loadgen.HttpTransport)
+                self.send_header(
+                    "X-Khipu-Served-Ms", f"{served_ms:.3f}"
+                )
                 self.end_headers()
                 self.wfile.write(payload)
 

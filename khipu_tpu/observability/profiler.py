@@ -103,6 +103,11 @@ KNOWN_SITES = frozenset({
     # window-spill appends, segment-streamed snapshot ingest, the
     # compaction copy phase, and rebalance segment-ship bytes
     "kesque.append", "kesque.ingest", "kesque.compact", "kesque.ship",
+    # replica fleet (serving/replica.py + serving/fleet.py): the
+    # follower tail pass and the router's per-request routing
+    # decision — chaos seams first (the kill sweep in test_fleet.py
+    # drives them), ledger sites if the tail ever meters bulk bytes
+    "replica.tail", "fleet.route",
     # bench/metrics self-checks
     "bench.smoke",
 })

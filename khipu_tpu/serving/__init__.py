@@ -32,6 +32,7 @@ from khipu_tpu.serving.admission import (
     journal_pressure,
     pipeline_pressure,
     rebalance_pressure,
+    replica_lag_pressure,
     txpool_pressure,
 )
 from khipu_tpu.serving.readview import ReadView
@@ -39,7 +40,11 @@ from khipu_tpu.serving.slo import SloPolicy, SloTracker
 
 __all__ = [
     "AdmissionController",
+    "FleetRouter",
+    "PrimaryFeed",
+    "ReadToken",
     "ReadView",
+    "ReplicaDriver",
     "ServerBusy",
     "ServingPlane",
     "SloPolicy",
@@ -49,8 +54,27 @@ __all__ = [
     "journal_pressure",
     "pipeline_pressure",
     "rebalance_pressure",
+    "replica_lag_pressure",
     "txpool_pressure",
 ]
+
+
+def __getattr__(name):
+    # fleet pieces import jsonrpc (which imports this package back
+    # through admission) — lazy re-export keeps the package cycle-free
+    if name in ("FleetRouter",):
+        from khipu_tpu.serving.fleet import FleetRouter
+
+        return FleetRouter
+    if name in ("PrimaryFeed", "ReplicaDriver"):
+        from khipu_tpu.serving import replica as _replica
+
+        return getattr(_replica, name)
+    if name == "ReadToken":
+        from khipu_tpu.serving.router import ReadToken
+
+        return ReadToken
+    raise AttributeError(name)
 
 
 class ServingPlane:

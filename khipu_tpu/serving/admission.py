@@ -379,6 +379,32 @@ def rebalance_pressure(rebalancer) -> Callable[[], float]:
     return signal
 
 
+def replica_lag_pressure(replica,
+                         max_lag_blocks: Optional[int] = None
+                         ) -> Callable[[], float]:
+    """Follower-staleness shed signal (serving/replica.py): the
+    replica's committed-height lag behind the primary over
+    ``ServingConfig.max_replica_lag_blocks``. Installed on each
+    REPLICA's admission plane, so a wedged or far-behind follower
+    sheds the reads the FleetRouter sends it (read class sheds at
+    ``shed_read_at`` — lag past ~95% of the bound) instead of serving
+    stale state, with ``replica_lag`` taking the shed blame the same
+    way the PR 10 signals attribute theirs. A healthy tail holds this
+    at ~0 (it catches up within one poll interval)."""
+    if max_lag_blocks is None:
+        max_lag_blocks = replica.config.serving.max_replica_lag_blocks
+    scale = max(1, max_lag_blocks)
+
+    def signal() -> float:
+        try:
+            return replica.lag_blocks() / scale
+        except Exception:
+            return 0.0
+
+    signal.signal_name = "replica_lag"
+    return signal
+
+
 def cluster_pressure(telemetry) -> Callable[[], float]:
     """Per-shard health folded into admission (the ROADMAP seam:
     "feed admission from per-shard health instead of local signals

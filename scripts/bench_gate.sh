@@ -56,6 +56,9 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --reorg --smoke
 echo "== ingest smoke (segment ingest < 3x the per-node walk, read amp >= 1.5x, or a missing khipu_kesque_* family fails the gate) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --ingest --smoke
 
+echo "== fleet serve smoke (a stale read under a consistent-read token, an unmirrored reorg, or a missing khipu_fleet_* family fails the gate) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --serve --http --smoke
+
 echo "== bench regression gate (baseline: $BASELINE) =="
 # --diff: on a failure (or any movement past tolerance) print the
 # differential attribution — WHICH phase/sub-phase site moved and by

@@ -1,0 +1,499 @@
+"""Replica fleet (serving/replica.py + serving/fleet.py +
+serving/router.py — docs/serving.md "Replica fleet").
+
+The headline guarantees: a consistent-read token is NEVER answered
+with state older than its height — across replica failover (the
+wait-or-redirect path counts the redirect) and across a PR 15 reorg
+(a retracted token re-anchors to the fork ancestor); a primary reorg
+MIRRORS through each replica's own journaled switch, so ``removed:
+true`` retractions and adopted-block redelivery reach every replica's
+FilterManager exactly once; and a 120-seed kill sweep over the
+``replica.tail`` / ``fleet.route`` seam pair lands every replica at a
+hash-exact prefix of the primary chain, converging to the full chain
+once the tail resumes.
+"""
+
+import dataclasses
+import random
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.chaos import FaultPlan, FaultRule, InjectedDeath, active
+from khipu_tpu.config import ServingConfig, SyncConfig, fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.jsonrpc import EthService, JsonRpcServer
+from khipu_tpu.serving.fleet import FleetRouter
+from khipu_tpu.serving.readview import ReadView
+from khipu_tpu.serving.replica import PrimaryFeed, ReplicaDriver
+from khipu_tpu.serving.router import ReadToken, pick2
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.sync.reorg import ReorgManager
+from khipu_tpu.sync.replay import ReplayDriver, ReplayStats
+
+pytestmark = pytest.mark.chaos
+
+CFG = dataclasses.replace(
+    fixture_config(chain_id=1),
+    sync=SyncConfig(commit_window_blocks=1, parallel_tx=False),
+    serving=ServingConfig(
+        replica_poll_interval=0.002, ryw_wait_s=0.5
+    ),
+)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(4)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ETH = 10**18
+ALLOC = {a: 1000 * ETH for a in ADDRS}
+GEN = GenesisSpec(alloc=ALLOC)
+MINER_A = b"\xaa" * 20
+MINER_B = b"\xbb" * 20
+
+
+def _tx(i, nonce, to, value):
+    return sign_transaction(
+        Transaction(nonce, 10**9, 21_000, to, value),
+        KEYS[i], chain_id=1,
+    )
+
+
+def build(n, diverge_at=None, value_off=0):
+    """Consensus-true chain of ``n`` transfer blocks; from
+    ``diverge_at`` on, coinbase and tx values change (same senders
+    and nonces — a real competing branch, not a replay)."""
+    builder = ChainBuilder(Blockchain(Storages(), CFG), CFG, GEN)
+    blocks, nonces = [], [0, 0, 0, 0]
+    for k in range(n):
+        i = k % 4
+        diverged = diverge_at is not None and k >= diverge_at
+        blocks.append(builder.add_block(
+            [_tx(i, nonces[i], ADDRS[(i + 1) % 4],
+                 100 + k + (value_off if diverged else 0))],
+            coinbase=MINER_B if diverged else MINER_A,
+            timestamp=10 * (k + 1),
+        ))
+        nonces[i] += 1
+    return builder.blockchain, blocks
+
+
+@pytest.fixture(scope="module")
+def chains():
+    """(base 10, fork 10 diverging at 5) for the router tests plus a
+    smaller (base 6, fork 8 diverging at 3) pair for the seed sweep —
+    built once; every node under test re-imports through the
+    validated replay path."""
+    base_bc, base = build(10)
+    fork_bc, fork = build(10, diverge_at=5, value_off=1000)
+    sweep_base_bc, sweep_base = build(6)
+    sweep_fork_bc, sweep_fork = build(8, diverge_at=3, value_off=500)
+    return {
+        "base_bc": base_bc, "base": base,
+        "fork_bc": fork_bc, "fork": fork,
+        "sweep_base_bc": sweep_base_bc, "sweep_base": sweep_base,
+        "sweep_fork_bc": sweep_fork_bc, "sweep_fork": sweep_fork,
+    }
+
+
+class _Primary:
+    """A full primary node (store + replay driver + journaled reorg +
+    RPC service/server) synced through ``blocks[:upto]``."""
+
+    def __init__(self, blocks, upto, config=CFG):
+        self.bc = Blockchain(Storages(), config)
+        self.bc.load_genesis(GEN)
+        self.view = ReadView(self.bc)
+        self.driver = ReplayDriver(self.bc, config, read_view=self.view)
+        self.reorg = ReorgManager(
+            self.bc, config, driver=self.driver, read_view=self.view
+        )
+        self.service = EthService(
+            self.bc, config, read_view=self.view,
+            reorg_manager=self.reorg,
+        )
+        self.server = JsonRpcServer(self.service)
+        self.stats = ReplayStats()
+        for b in blocks[:upto]:
+            self.driver._execute_and_insert(b, self.stats)
+        self.feed = PrimaryFeed(self.bc)
+
+    def import_block(self, block):
+        self.driver._execute_and_insert(block, self.stats)
+
+
+def _tail_until(replica, number, block_hash=None, limit=200):
+    """Drive ``tail_once`` until the replica serves ``number`` (and,
+    when given, the exact hash there). Bounded: a wedged tail fails
+    the test instead of hanging it."""
+    for _ in range(limit):
+        h = replica.blockchain.get_header_by_number(number)
+        if h is not None and (block_hash is None or h.hash == block_hash):
+            return
+        replica.tail_once()
+    raise AssertionError(
+        f"replica {replica.name} never reached block {number}"
+    )
+
+
+def _read(router, token=None, method="eth_blockNumber", params=()):
+    req = {
+        "jsonrpc": "2.0", "id": 1,
+        "method": method, "params": list(params),
+    }
+    if token is not None:
+        req["khipuToken"] = token
+    return router.handle(req)
+
+
+# ------------------------------------------------------- token codec
+
+
+def test_token_roundtrip_with_hash():
+    t = ReadToken(chain_id=1, number=7, block_hash=b"\x11" * 32)
+    assert ReadToken.decode(t.encode()) == t
+
+
+def test_token_roundtrip_without_hash():
+    t = ReadToken(chain_id=5, number=2**40, block_hash=None)
+    assert ReadToken.decode(t.encode()) == t
+
+
+def test_token_garbage_downgrades_to_none():
+    # malformed tokens must degrade the request to tokenless routing,
+    # never error it — decode returns None for every shape of garbage
+    for raw in (None, 123, "", "zz", "0x", "0xzz",
+                "0x" + "ab" * 20,   # 20-byte body: neither 16 nor 48
+                "0x" + "ab" * 47):
+        assert ReadToken.decode(raw) is None
+
+
+# ------------------------------------------------------------- pick2
+
+
+def test_pick2_excludes_zero_weight():
+    rng = random.Random(0)
+    for _ in range(100):
+        got = pick2(rng, ["dead", "live"],
+                    weight_fn=lambda c: 0.0 if c == "dead" else 1.0,
+                    load_fn=lambda c: 0)
+        assert got == "live"
+    assert pick2(rng, ["a", "b"], lambda c: 0.0, lambda c: 0) is None
+    assert pick2(rng, [], lambda c: 1.0, lambda c: 0) is None
+
+
+def test_pick2_lower_load_wins():
+    rng = random.Random(0)
+    loads = {"a": 5, "b": 1}
+    for _ in range(100):
+        assert pick2(rng, ["a", "b"], lambda c: 1.0,
+                     loads.__getitem__) == "b"
+
+
+def test_pick2_health_weights_traffic():
+    rng = random.Random(0)
+    weights = {"healthy": 1.0, "sick": 0.05}
+    picks = [pick2(rng, ["healthy", "sick"], weights.__getitem__,
+                   lambda c: 0) for _ in range(400)]
+    # both draws fall on the healthy replica most rounds; the sick one
+    # still gets SOME traffic (weighted, not excluded)
+    assert picks.count("healthy") > 300
+    assert picks.count("sick") > 0
+
+
+# ------------------------------------------------------- replica tail
+
+
+def test_replica_tails_to_primary_head(chains):
+    p = _Primary(chains["base"], 8)
+    r = ReplicaDriver("tail", p.feed, CFG, GEN)
+    _tail_until(r, 8, chains["base"][7].header.hash)
+    assert r.blockchain.best_block_number == 8
+    for n in range(0, 9):
+        assert (r.blockchain.get_header_by_number(n).hash
+                == p.feed.hash_of(n))
+    assert r.lag_blocks() == 0
+    # state parity, not just headers: the replica re-executed, so it
+    # serves the same balances the primary does
+    addr = "0x" + ADDRS[0].hex()
+    assert (r.service.eth_getBalance(addr, "latest")
+            == p.service.eth_getBalance(addr, "latest"))
+
+
+def test_replica_rejects_mismatched_genesis(chains):
+    p = _Primary(chains["base"], 4)
+    other = GenesisSpec(alloc={ADDRS[0]: 7 * ETH})
+    with pytest.raises(ValueError, match="genesis"):
+        ReplicaDriver("bad-gen", p.feed, CFG, other)
+
+
+def test_reorg_retraction_reaches_lagging_replica_filter(chains):
+    """A primary switch must reach a LAGGING replica's FilterManager
+    through the replica's own mirrored switch: the adopted blocks are
+    redelivered to its block filter exactly once, and retracted log
+    state rewinds — no duplicate retraction on later polls."""
+    base, fork = chains["base"], chains["fork"]
+    p = _Primary(base, 8)
+    r = ReplicaDriver("lag", p.feed, CFG, GEN)
+    _tail_until(r, 8, base[7].header.hash)
+    fm = r.service._filter_manager
+    fid = fm.new_block_filter()
+    assert fm.changes(fid) == []  # installed at the tip: no backlog
+    # the primary adopts the fork while the replica is NOT polling —
+    # it only learns of the switch on its next manual tail pass
+    p.reorg.switch(5, fork[5:])
+    assert p.bc.best_block_number == 10
+    _tail_until(r, 10, fork[9].header.hash)
+    assert r.switches_mirrored == 1
+    # blocks 1..5 are shared, so exactly the adopted suffix redelivers
+    assert fm.changes(fid) == [b.header.hash for b in fork[5:]]
+    assert fm.changes(fid) == []  # once — no duplicate retraction
+    # and the replica's canonical chain is the fork, height for height
+    for n in range(0, 11):
+        assert (r.blockchain.get_header_by_number(n).hash
+                == p.feed.hash_of(n))
+
+
+# ---------------------------------------------- failover + RYW tokens
+
+
+def test_failover_mid_poll_zero_stale_reads(chains):
+    """Token-bearing reads keep their floor across a replica kill
+    mid-polling: every response's height >= the echoed token's
+    height, with zero stale reads before, during, and after the
+    failover."""
+    base = chains["base"]
+    p = _Primary(base, 5)
+    r1 = ReplicaDriver("f1", p.feed, CFG, GEN).start()
+    r2 = ReplicaDriver("f2", p.feed, CFG, GEN).start()
+    router = FleetRouter(
+        p.server, [r1, r2], reorg_manager=p.reorg, seed=1
+    )
+    try:
+        assert r1.ensure_height(5, 5.0) and r2.ensure_height(5, 5.0)
+        token = None
+        after_kill = 0
+        for step in range(12):
+            if step in (4, 6, 8, 10):  # primary keeps committing
+                p.import_block(base[5 + (step - 4) // 2])
+            if step == 6:  # kill one replica mid-poll
+                r1.kill()
+                assert not r1.alive()
+            resp = _read(router, token=token)
+            assert "error" not in resp
+            floor = ReadToken.decode(token).number if token else 0
+            got = int(resp["result"], 16)
+            assert got >= floor, (
+                f"stale read at step {step}: {got} < token {floor}"
+            )
+            token = resp["khipuToken"]
+            if step > 6:
+                after_kill += 1
+        assert after_kill >= 5 and r2.alive()
+        # the surviving replica converged on the primary's chain
+        assert r2.ensure_height(9, 5.0)
+        assert r2.has_block(9, base[8].header.hash)
+    finally:
+        r1.kill()
+        r2.kill()
+
+
+def test_ryw_redirect_counted_on_lagging_replica(chains):
+    """Deterministic wait-or-redirect: an ALIVE replica parked on a
+    long poll interval lags the primary; a token at the primary's
+    height cannot be honored within the RYW budget, so the read
+    redirects to the primary and the redirect is counted. A tokenless
+    read meanwhile happily serves the replica's older height."""
+    base = chains["base"]
+    lag_cfg = dataclasses.replace(
+        CFG, serving=ServingConfig(
+            replica_poll_interval=60.0, ryw_wait_s=0.02
+        ),
+    )
+    p = _Primary(base, 8, config=lag_cfg)
+    r = ReplicaDriver("lagger", p.feed, lag_cfg, GEN).start()
+    router = FleetRouter(p.server, [r], reorg_manager=p.reorg, seed=2)
+    try:
+        assert r.ensure_height(8, 5.0)
+        # the replica's tail is now asleep for 60s; advance the primary
+        p.import_block(base[8])
+        p.import_block(base[9])
+        assert r.lag_blocks() == 2 and r.alive()
+        # tokenless: the replica serves its own (older) height
+        resp = _read(router)
+        assert int(resp["result"], 16) == 8
+        assert router.reads_replica == 1
+        # token at the primary head: floor 10 > replica head 8, the
+        # 20ms budget cannot cover a 60s poll -> redirect + count
+        token = ReadToken(1, 10, base[9].header.hash).encode()
+        resp = _read(router, token=token)
+        assert int(resp["result"], 16) == 10  # primary served
+        assert router.ryw_redirects == 1
+        # the fresh token re-minted from the primary carries height 10
+        assert ReadToken.decode(resp["khipuToken"]).number == 10
+    finally:
+        r.kill()
+
+
+def test_retracted_token_reanchors_to_fork_ancestor(chains):
+    """A token anchored to a block the reorg threw away re-anchors to
+    the fork ancestor (counted), so any caught-up replica can serve
+    it — the write it certified is gone, and 'no older than the
+    ancestor' is the strongest honest floor left."""
+    base, fork = chains["base"], chains["fork"]
+    p = _Primary(base, 8)
+    r = ReplicaDriver("re-anchor", p.feed, CFG, GEN)
+    router = FleetRouter(p.server, [r], reorg_manager=p.reorg, seed=3)
+    _tail_until(r, 8, base[7].header.hash)
+    stale = ReadToken(1, 7, base[6].header.hash).encode()
+    p.reorg.switch(5, fork[5:])
+    _tail_until(r, 10, fork[9].header.hash)
+    # the replica never started a thread -> not alive -> pick2 skips
+    # it; start it so liveness-weighted routing sees a live candidate
+    r.start()
+    try:
+        resp = _read(router, token=stale)
+        assert "error" not in resp
+        assert router.tokens_reanchored == 1
+        assert router.ryw_redirects == 0  # ancestor floor: no redirect
+        assert router.snapshot()["lastAncestor"] == 5
+        # a foreign-chain token is ignored outright, not re-anchored
+        foreign = ReadToken(999, 7, base[6].header.hash).encode()
+        _read(router, token=foreign)
+        assert router.tokens_reanchored == 1
+    finally:
+        r.kill()
+
+
+# --------------------------------------------------- 120-seed sweep
+
+SWEEP_SITES = ["replica.tail", "fleet.route"]
+
+
+@pytest.fixture(scope="module")
+def sweep_primaries(chains):
+    """The two feed states a sweep replica tails: the base chain at 6
+    and the fork chain at 8. Swapping a replica's feed from one to
+    the other IS a primary reorg as far as the follower can tell —
+    same divergence walk, same mirrored switch — without rebuilding a
+    primary per seed."""
+    before = _Primary(chains["sweep_base"], 6)
+    after = _Primary(chains["sweep_fork"], 8)
+    return before, after
+
+
+def _assert_prefix_of(replica, feed):
+    """The dead-anywhere invariant: every block the replica holds is
+    the feed's block at that height — a hash-exact prefix, never a
+    mix of branches past what the feed serves."""
+    best = replica.blockchain.best_block_number
+    for n in range(0, best + 1):
+        h = replica.blockchain.get_header_by_number(n)
+        assert h is not None and h.hash == feed.hash_of(n), (
+            f"replica diverges from primary at block {n}"
+        )
+
+
+def _run_tail_seed(seed, after, sweep_primaries, chains):
+    """Catch up on the base feed, live through a feed switch (the
+    primary reorg), with one injected death staggered through the
+    ``replica.tail`` seam. Returns True when the death fired."""
+    before, after_p = sweep_primaries
+    r = ReplicaDriver(f"sweep-{seed}", before.feed, CFG, GEN)
+    plan = FaultPlan(seed=seed, rules=[
+        FaultRule("replica.tail", kind="die", times=1, after=after),
+    ])
+    died = False
+    try:
+        with active(plan):
+            _tail_until(r, 6, chains["sweep_base"][5].header.hash)
+            r.feed = after_p.feed  # the primary reorged under us
+            _tail_until(r, 8, chains["sweep_fork"][7].header.hash)
+    except InjectedDeath:
+        died = True
+        # fail-stop at the seam: whatever landed must be a prefix of
+        # ONE of the primary states (never an interleaving)
+        feed = (before.feed
+                if r.blockchain.best_block_number <= 6
+                and r.switches_mirrored == 0
+                and r.blockchain.get_header_by_number(
+                    min(r.blockchain.best_block_number, 4)
+                ).hash == before.feed.hash_of(
+                    min(r.blockchain.best_block_number, 4))
+                else r.feed)
+        _assert_prefix_of(r, feed)
+    # recovery: the tail resumes (plan inactive) and must converge on
+    # the current primary chain exactly
+    r.feed = after_p.feed
+    _tail_until(r, 8, chains["sweep_fork"][7].header.hash)
+    _assert_prefix_of(r, after_p.feed)
+    assert r.blockchain.best_block_number == 8
+    return died
+
+
+def _run_route_seed(seed, after, fleet):
+    """One injected death inside ``fleet.route``: the in-flight
+    request dies, the router does not — counters drain to zero and
+    the next read succeeds. Returns True when the death fired."""
+    router, replica = fleet
+    plan = FaultPlan(seed=seed, rules=[
+        FaultRule("fleet.route", kind="die", times=1, after=after),
+    ])
+    died = False
+    try:
+        with active(plan):
+            for _ in range(8):
+                resp = _read(router)
+                assert "error" not in resp
+    except InjectedDeath:
+        died = True
+    # the seam fires BEFORE inflight tracking: nothing leaks
+    assert sum(router._inflight.values()) == 0
+    resp = _read(router)
+    assert "error" not in resp
+    assert ReadToken.decode(resp["khipuToken"]) is not None
+    return died
+
+
+def test_fleet_seeded_kill_sweep(chains, sweep_primaries):
+    """120 seeds staggered across the ``replica.tail`` /
+    ``fleet.route`` seam pair. Every ``replica.tail`` death lands the
+    replica at a hash-exact prefix of a primary chain state and
+    recovery converges on the fork tip; every ``fleet.route`` death
+    kills one request, never the router. The stagger must actually
+    exercise both outcomes: > 20 killed and > 20 survived.
+
+    The two seam groups run back to back (same seed/stagger layout):
+    fault plans are process-global while active, so the route fleet —
+    whose replica runs a background tail thread full of
+    ``replica.tail`` hits — must not exist while a tail seed's single
+    ``times=1`` death is armed, or the poller races the sweep replica
+    for it."""
+    killed = survived = 0
+    stagger = {
+        seed: (seed // len(SWEEP_SITES)) % 16 for seed in range(120)
+    }
+    for seed, after in stagger.items():
+        if SWEEP_SITES[seed % len(SWEEP_SITES)] != "replica.tail":
+            continue
+        if _run_tail_seed(seed, after, sweep_primaries, chains):
+            killed += 1
+        else:
+            survived += 1
+    p = _Primary(chains["base"], 8)
+    r = ReplicaDriver("route-r", p.feed, CFG, GEN).start()
+    router = FleetRouter(p.server, [r], reorg_manager=p.reorg, seed=7)
+    try:
+        assert r.ensure_height(8, 5.0)
+        for seed, after in stagger.items():
+            if SWEEP_SITES[seed % len(SWEEP_SITES)] != "fleet.route":
+                continue
+            if _run_route_seed(seed, after, (router, r)):
+                killed += 1
+            else:
+                survived += 1
+    finally:
+        r.kill()
+    assert killed > 20 and survived > 20, (killed, survived)
