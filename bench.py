@@ -2156,9 +2156,14 @@ def bench_serve(smoke=False):
     )
 
 
-def _fleet_setup(n_blocks, txs_per_block=4):
+def _fleet_setup(n_blocks, txs_per_block=4, sync_kwargs=None,
+                 serving_kwargs=None):
     """Primary + fork branch + 2 read replicas + FleetRouter, wired
-    for ``bench.py --serve --http``.
+    for ``bench.py --serve --http`` (and, with ``sync_kwargs``
+    overriding the target's SyncConfig — e.g. a windowed pipeline so
+    the collector stages are live — for ``bench.py --gameday``).
+    Fixture chains are always BUILT under the serial window=1 config,
+    whatever the target runs.
 
     The fixture chain is shaped so the loadgen's monotone RYW checker
     stays SOUND across the mid-run reorg: blocks up to the fork
@@ -2195,11 +2200,16 @@ def _fleet_setup(n_blocks, txs_per_block=4):
     from khipu_tpu.sync.reorg import ReorgManager
     from khipu_tpu.txpool import PendingTransactionsPool
 
-    serve_cfg = ServingConfig(queue_timeout=0.004, max_queue=4)
-    cfg = dataclasses.replace(
+    serve_cfg = ServingConfig(
+        queue_timeout=0.004, max_queue=4, **(serving_kwargs or {})
+    )
+    build_cfg = dataclasses.replace(
         fixture_config(chain_id=1),
         sync=SyncConfig(parallel_tx=False, commit_window_blocks=1),
         serving=serve_cfg,
+    )
+    cfg = build_cfg if sync_kwargs is None else dataclasses.replace(
+        build_cfg, sync=SyncConfig(**sync_kwargs),
     )
     nsenders = 8
     keys, addrs = _replay_keys(nsenders)
@@ -2215,7 +2225,7 @@ def _fleet_setup(n_blocks, txs_per_block=4):
 
     def build(total, value_off, suffix_coinbase):
         builder = ChainBuilder(
-            Blockchain(Storages(), cfg), cfg, genesis
+            Blockchain(Storages(), build_cfg), build_cfg, genesis
         )
         blocks, nonces = [], [0] * nsenders
         for n in range(total):
@@ -2306,7 +2316,7 @@ def _fleet_setup(n_blocks, txs_per_block=4):
     )
     return (cfg, target, wire, fork_wire, ancestor, addrs,
             checked_receivers, plane, service, server, driver, reorg,
-            replicas, telemetry, router)
+            replicas, telemetry, router, build_cfg, genesis)
 
 
 def bench_serve_http(smoke=False):
@@ -2343,7 +2353,7 @@ def bench_serve_http(smoke=False):
     n_blocks = 10 if smoke else 48
     (cfg, target, wire, fork_wire, ancestor, addrs, receivers, plane,
      service, server, driver, reorg, replicas, telemetry,
-     router) = _fleet_setup(n_blocks)
+     router, _build_cfg, _genesis) = _fleet_setup(n_blocks)
     port = router.start_http()
     url = f"http://127.0.0.1:{port}/"
     nonce_addrs = ["0x" + a.hex() for a in addrs[:4]]
@@ -2933,6 +2943,528 @@ def bench_reorg(smoke=False, deadline_s=120.0):
     )
 
 
+def _gameday_run(smoke, seed, result):
+    """The composed gameday scenario (docs/gameday.md), run on a
+    worker thread under ``bench_gameday``'s hard deadline.
+
+    One seeded timeline over a LIVE fleet (primary + 2 replicas +
+    3-shard cluster) importing under 4x MIXED overload:
+
+      e1.join            — a 4th shard joins mid-import
+      e2.collector.die   — the persist stage worker dies (SIGKILL
+                           model; the pipeline degrades to sync
+                           commits and keeps going)
+      e3.replica.die     — one replica's tail thread dies (failover)
+      e4.shard.die       — shard s1 goes permanently unreachable
+                           (every call raises; reads fail over to the
+                           other replica of each key)
+      e5.fork            — fork battle: a heavier branch displaces
+                           the tip 2 blocks below it, retracting
+                           served blocks, under live token traffic
+
+    Events fire at BLOCK HEIGHTS (ScenarioEngine.step from the import
+    loop), never wall-clock, so the composition replays identically
+    for a seed. Gates: the full invariant set (chaos/invariants.py) —
+    zero RYW violations, retraction visible on every replica, token
+    floors honest, exactly-old-or-new ring epoch, final roots
+    bit-exact vs a fresh serial replay — plus, in full mode, admitted
+    p99 within 5x the unloaded floor."""
+    import dataclasses
+    import threading
+
+    from khipu_tpu.base.crypto.keccak import keccak256
+    from khipu_tpu.chaos import (
+        FaultPlan,
+        FaultRule,
+        Scenario,
+        ScenarioEngine,
+        ScenarioEvent,
+        active,
+        check_admission_p99,
+        check_epoch,
+        check_retraction,
+        check_roots_bit_exact,
+        check_ryw,
+        check_token_floor,
+        fault_log,
+        merge_plans,
+        quiet_deaths,
+        record_run,
+    )
+    from khipu_tpu.chaos.invariants import InvariantReport
+    from khipu_tpu.chaos.scenario import clear_current_event
+    from khipu_tpu.cluster import Rebalancer, ShardedNodeClient
+    from khipu_tpu.config import TelemetryConfig
+    from khipu_tpu.domain.block import Block as _Block
+    from khipu_tpu.domain.blockchain import Blockchain
+    from khipu_tpu.observability.telemetry import Watchdog
+    from khipu_tpu.serving.loadgen import (
+        MIXED,
+        READ_ONLY,
+        InProcessTransport,
+        LoadGenerator,
+    )
+    from khipu_tpu.storage.storages import Storages
+    from khipu_tpu.sync.replay import PIPELINE_GAUGES, ReplayDriver
+
+    n_blocks = 10 if smoke else 48
+    (cfg, target, wire, fork_wire, ancestor, addrs, receivers, plane,
+     service, server, driver, reorg, replicas, telemetry, router,
+     build_cfg, genesis) = _fleet_setup(
+        n_blocks,
+        # windowed pipeline so the collector stages are LIVE targets
+        sync_kwargs={"parallel_tx": False, "commit_window_blocks": 2,
+                     "pipeline_depth": 2},
+        # tight wait-or-redirect budget: a token-bearing read pays at
+        # most 10ms waiting on a lagging replica before the router
+        # redirects it to the primary — the operational posture for a
+        # latency-gated fleet (docs/serving.md); the default 50ms
+        # budget optimizes for replica offload instead and would
+        # dominate the admitted tail under overload
+        serving_kwargs={"ryw_wait_s": 0.01},
+    )
+
+    # ------------------------------------------------ shard cluster
+    from khipu_tpu.cluster.ring import _point
+
+    class _Shard:
+        def __init__(self):
+            self.store = {}
+
+        def get_node_data(self, hashes):
+            return {h: self.store[h] for h in hashes if h in self.store}
+
+        def put_node_data(self, nodes):
+            self.store.update(nodes)
+            return len(nodes)
+
+        def stream_node_data(self, ranges, cursor, count):
+            snap = dict(self.store)
+            keys = sorted(
+                k for k in snap
+                if cursor < k
+                and any(lo <= _point(k) < hi for lo, hi in ranges)
+            )
+            page = keys[:count]
+            done = len(keys) <= count
+            nxt = page[-1] if page else bytes(cursor)
+            return done, nxt, [(k, snap[k]) for k in page]
+
+        def ping(self, payload=b""):
+            return payload
+
+        def close(self):
+            pass
+
+    shards = {ep: _Shard() for ep in ("s0", "s1", "s2", "s3")}
+    cluster = ShardedNodeClient(
+        ["s0", "s1", "s2"],
+        channel_factory=lambda ep: shards[ep],
+        sleep=lambda s: None,
+    )
+    rb = Rebalancer(cluster, batch=128)
+    n_keys = 600 if smoke else 4000
+    data = {}
+    for i in range(n_keys):
+        v = b"gameday node %d" % i
+        data[keccak256(v)] = v
+    cluster.replicate(data)
+    cluster_keys = sorted(data)
+    old_epoch = cluster.ring.epoch
+    join_state = {}
+
+    def run_join(_event):
+        def work():
+            try:
+                join_state["streamed"] = rb.join("s3")
+            except Exception as e:  # a shard death mid-stream rolls back
+                join_state["error"] = f"{type(e).__name__}: {e}"
+                rb.recover()
+
+        t = threading.Thread(target=work, daemon=True, name="gd-join")
+        t.start()
+        join_state["thread"] = t
+
+    # -------------------------------------------------- the timeline
+    def h(frac):
+        return max(1, int(n_blocks * frac))
+
+    fork_event = ScenarioEvent(
+        "e5.fork", n_blocks, "fork",
+        params={"ancestor": ancestor},
+    )
+    scenario = Scenario(seed, [
+        ScenarioEvent("e1.join", h(0.2), "join"),
+        ScenarioEvent("e2.collector.die", h(0.4), "die",
+                      "collector.persist"),
+        ScenarioEvent("e3.replica.die", h(0.45), "die", "replica.tail"),
+        ScenarioEvent("e4.shard.die", h(0.6), "raise", "cluster.call:s1",
+                      {"times": None}),
+        fork_event,
+    ])
+    # ambient background noise composed with the scenario through
+    # merge_plans — per-(rule, site) RNG independence means arming the
+    # scripted hazards cannot shift the ambient draws
+    ambient = FaultPlan(seed=seed + 1, rules=[
+        FaultRule("storage.node.get", "latency", prob=0.001,
+                  latency_s=0.0002),
+    ])
+    plan = merge_plans(FaultPlan(seed=seed), ambient)
+
+    reorged = {}
+
+    def run_fork(event):
+        # fork battle, synchronous on the import thread, under the
+        # live overload/token traffic still running on worker threads
+        reorg.switch(event.params["ancestor"], fork_wire[ancestor:])
+        reorged["done"] = True
+
+    engine = ScenarioEngine(
+        scenario, plan, hooks={"join": run_join, "fork": run_fork},
+    )
+    result["schedule"] = scenario.schedule()
+
+    # watchdog with an injectable journal-depth source: the smoke
+    # trips it deterministically AFTER the scenario fired, pinning the
+    # scenario correlation label on khipu_watchdog_trips_total
+    depth_cell = {"depth": 0}
+    wd = Watchdog(
+        config=TelemetryConfig(enabled=True),
+        journal_depth=lambda: depth_cell["depth"],
+    )
+
+    def gen(transport, profile, clients, reqs, seed_, key_base,
+            rate=None, duration=0.0):
+        return LoadGenerator(
+            transport, profile, clients=clients, seed=seed_,
+            max_requests=reqs, rate=rate, duration=duration,
+            nonce_addresses=["0x" + a.hex() for a in addrs[:4]],
+            balance_addresses=["0x" + r.hex() for r in receivers],
+            client_keys=[
+                (key_base + i).to_bytes(32, "big")
+                for i in range(clients)
+            ],
+            chain_id=1,
+        )
+
+    transport = InProcessTransport(router)
+
+    # phase A: unloaded floor (no faults installed) — the SAME mixed
+    # profile the overload offers, so the 5x budget compares like with
+    # like (a read-only floor would understate what an unloaded write
+    # actually costs)
+    floor = gen(transport, MIXED, 2, 30 if smoke else 150, 11,
+                0x0A11_0000).run()
+    p99_floor = floor.p99()
+
+    # capacity probe (full mode): a short closed-loop MIXED saturation
+    # run sizes the overload phase — the open loop then OFFERS 4x this
+    # completed rate, so "4x overload" is a rate claim about offered
+    # vs sustainable load, not a thread-count claim whose GIL
+    # contention would corrupt the admitted tail it gates
+    capacity_qps = None
+    if not smoke:
+        probe = gen(transport, MIXED, 6, 20, 17, 0x0E17_0000).run()
+        capacity_qps = probe.ok / probe.seconds if probe.seconds else 0.0
+
+    deaths_before = PIPELINE_GAUGES["collector_deaths"]
+    slice_w = 4
+    # throttle the import so the hazard timeline spans the overload
+    # window (heights are the clock; the throttle only stretches them
+    # across the load phase)
+    delay = 0.01 if smoke else 0.25
+
+    with quiet_deaths(), active(plan):
+        # 4x MIXED overload riding the whole hazard timeline: smoke
+        # keeps a small closed loop (mechanics only); full mode offers
+        # an OPEN-loop 4x the probed capacity for the import's span
+        if smoke:
+            overload_gen = gen(transport, MIXED, 8, 25, 22, 0x0B22_0000)
+        else:
+            # 4 worker threads are a concurrency limit, not the load
+            # claim — the OFFERED rate is the 4x; more workers would
+            # only add GIL convoying to the admitted tail under test
+            overload_gen = gen(
+                transport, MIXED, 4, 0, 22, 0x0B22_0000,
+                rate=4.0 * capacity_qps, duration=10.0,
+            )
+        over_box = {}
+
+        def run_overload():
+            over_box["report"] = overload_gen.run()
+
+        over_t = threading.Thread(target=run_overload, daemon=True,
+                                  name="gd-overload")
+        over_t.start()
+
+        # the import loop IS the milestone clock: scenario events fire
+        # between window slices, keyed to committed height
+        import time as _t
+
+        i = 0
+        while i < len(wire):
+            engine.step(target.best_block_number)
+            driver.replay(wire[i:i + slice_w])
+            # deterministic cluster probe each milestone: content-
+            # verified reads keep flowing through joins and deaths
+            off = (i * 13) % len(cluster_keys)
+            sample = cluster_keys[off:off + 8]
+            got = cluster.fetch(sample)
+            for k_, v_ in got.items():
+                assert v_ == data[k_], "cluster served wrong bytes"
+            i += slice_w
+            _t.sleep(delay)
+        wd.check_once()
+
+        # fork battle (e5) fires here — import is complete, overload
+        # may still be in flight, and a READ_ONLY token generation
+        # runs THROUGH the retraction
+        ryw_box = {}
+        ryw_gen = gen(transport, READ_ONLY, 2 if smoke else 4,
+                      15 if smoke else 40, 44, 0x0D44_0000)
+
+        def run_ryw():
+            ryw_box["report"] = ryw_gen.run()
+
+        ryw_t = threading.Thread(target=run_ryw, daemon=True,
+                                 name="gd-ryw")
+        ryw_t.start()
+        engine.step(target.best_block_number)
+        assert reorged.get("done"), "fork battle never ran"
+        ryw_t.join(timeout=120)
+        over_t.join(timeout=120)
+
+        # survivors converge on the adopted branch tip
+        fork_tip = len(fork_wire)
+        deadline = time.perf_counter() + 60
+        while time.perf_counter() < deadline:
+            alive = [r for r in replicas if r.alive()]
+            if alive and all(
+                r.head_number() == fork_tip for r in alive
+            ):
+                break
+            _t.sleep(0.02)
+
+        jt = join_state.get("thread")
+        if jt is not None:
+            jt.join(timeout=60)
+
+    assert engine.done(), f"unfired events: {engine.remaining()}"
+    overload = over_box["report"]
+    ryw = ryw_box["report"]
+
+    # the three seeded deaths all actually landed in THIS run
+    kinds_fired = {(site, kind) for (site, _, kind, _) in plan.fired}
+    assert ("collector.persist", "die") in kinds_fired, plan.fired
+    assert ("replica.tail", "die") in kinds_fired, plan.fired
+    assert ("cluster.call:s1", "raise") in kinds_fired, plan.fired
+    assert PIPELINE_GAUGES["collector_deaths"] > deaths_before
+    dead_replicas = [r for r in replicas if not r.alive()]
+    live_replicas = [r for r in replicas if r.alive()]
+    assert len(dead_replicas) == 1, [r.snapshot() for r in replicas]
+    assert cluster.metrics["s1"].failures > 0, "shard death never hit"
+
+    # ------------------------------------------------- the invariants
+    report = InvariantReport()
+    violations = (
+        list(floor.violations) + list(overload.violations)
+        + list(ryw.violations)
+    )
+    report.add(check_ryw(violations))
+    retracted = [
+        (n, wire[n - 1].header.hash)
+        for n in range(ancestor + 1, len(wire) + 1)
+    ]
+    report.add(check_retraction(target, replicas, retracted))
+    report.add(check_token_floor(router, retracted, ancestor))
+    report.add(check_epoch(rb, old_epoch, old_epoch + 1))
+    # every cluster key still content-verifiable through the ring,
+    # one shard dead and one joined (or rolled back) notwithstanding
+    all_back = {}
+    for off in range(0, len(cluster_keys), 256):
+        all_back.update(cluster.fetch(cluster_keys[off:off + 256]))
+    cluster_ok = all_back == data
+    from khipu_tpu.chaos.invariants import InvariantResult
+
+    report.add(InvariantResult(
+        "cluster_integrity", cluster_ok,
+        "" if cluster_ok else
+        f"{len(data) - len(all_back)} keys unreachable",
+    ))
+    # bit-exact final roots vs a FRESH serial replay of the canonical
+    # (post-fork) chain
+    ref_bc = Blockchain(Storages(), build_cfg)
+    ref_bc.load_genesis(genesis)
+    ref_driver = ReplayDriver(ref_bc, build_cfg)
+    ref_driver.replay([_Block.decode(b.encode()) for b in fork_wire])
+    report.add(check_roots_bit_exact(target, ref_bc))
+    p99_ms = overload.p99() * 1e3
+    floor_ms = p99_floor * 1e3
+    if not smoke:
+        # smoke gates on invariants only; full mode also holds the SLO
+        report.add(check_admission_p99(p99_ms, floor_ms, budget=5.0))
+
+    record_run(engine.events_by_kind, report, p99_ms)
+
+    # deterministic watchdog trip AFTER the timeline completed: the
+    # trip carries the last scenario event id as its correlation label
+    depth_cell["depth"] = 99
+    tripped = wd.check_once()
+    assert "journal_runaway" in tripped, tripped
+    snap = fault_log.snapshot()
+
+    result.update({
+        "report": report,
+        "p99_ms": p99_ms,
+        "floor_ms": floor_ms,
+        "overload": overload,
+        "ryw": ryw,
+        "floor": floor,
+        "faults": snap,
+        "events_fired": list(engine.fired),
+        "survivor": live_replicas[0].snapshot() if live_replicas else None,
+        "epoch": cluster.ring.epoch,
+        "join": {k: v for k, v in join_state.items() if k != "thread"},
+        "service": service,
+        "router": router,
+        "telemetry": telemetry,
+        "watchdog": wd,
+    })
+    clear_current_event()
+
+
+def bench_gameday(smoke=False, seed=0, deadline_s=None,
+                  chrome_out=None):
+    """``bench.py --gameday``: one seeded scenario composing every
+    failure mode the repo has proven in isolation — shard join +
+    collector death + replica death + shard death + fork battle,
+    under 4x overload — gated on the full invariant set and (full
+    mode) the admitted-p99 SLO. ``--smoke`` runs the short
+    deterministic timeline, gates on invariants only and pins the
+    khipu_gameday_* exposition families. Runs under a HARD deadline
+    on a worker thread: a wedged composition exits 1, never hangs the
+    gate.
+
+    The flight recorder is ON for the whole run and one merged chrome
+    trace is dumped per run (``--chrome-out=`` or a tempdir default):
+    every scenario event is a ``scenario.*`` instant in the same
+    timeline as the replay/serving spans, so the postmortem view shows
+    the hazard AND what the pipeline was doing when it landed."""
+    import os
+    import tempfile
+    import threading
+
+    from khipu_tpu.observability.trace import tracer
+
+    deadline_s = deadline_s or (150.0 if smoke else 300.0)
+    result = {}
+    errbox = {}
+
+    def drive():
+        try:
+            _gameday_run(smoke, seed, result)
+        except BaseException as e:  # noqa: BLE001 - report, then gate
+            import traceback
+
+            errbox["error"] = e
+            errbox["tb"] = traceback.format_exc()
+
+    tracer.enable()
+    worker = threading.Thread(target=drive, daemon=True)
+    worker.start()
+    worker.join(timeout=deadline_s)
+    tracer.disable()
+    trace_path = None
+    try:
+        from khipu_tpu.observability import export
+
+        trace_path = chrome_out or os.path.join(
+            tempfile.gettempdir(), f"gameday_trace_seed{seed}.json"
+        )
+        export.dump_chrome_trace(trace_path)
+    except Exception as e:  # noqa: BLE001 - the trace is a postmortem
+        print(f"bench_gameday: chrome trace not written: {e}",
+              file=sys.stderr)
+        trace_path = None
+    if worker.is_alive():
+        print(
+            f"bench_gameday: FAILED — scenario did not complete within "
+            f"{deadline_s}s (schedule={result.get('schedule')})",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    if "error" in errbox:
+        print(errbox["tb"], file=sys.stderr)
+        print("bench_gameday: FAILED — scenario raised", file=sys.stderr)
+        sys.exit(1)
+
+    report = result["report"]
+    if not report.ok:
+        for r in report.failures:
+            print(f"bench_gameday: INVARIANT {r.name}: {r.detail}",
+                  file=sys.stderr)
+        sys.exit(1)
+
+    if smoke:
+        # exposition: every gameday family exactly once, plus the
+        # watchdog correlation label stamped by the scenario
+        service = result["service"]
+        text = service.khipu_metrics_text()
+        for fam, kind in (
+            ("khipu_gameday_runs_total", "counter"),
+            ("khipu_gameday_events_total", "counter"),
+            ("khipu_gameday_invariant_checks_total", "counter"),
+            ("khipu_gameday_invariant_failures_total", "counter"),
+            ("khipu_gameday_last_p99_ms", "gauge"),
+        ):
+            n = text.count(f"# TYPE {fam} {kind}")
+            assert n == 1, f"{fam} TYPE lines: {n}"
+        assert 'khipu_watchdog_trips_total{kind="journal_runaway"' \
+            in text, "watchdog trip family missing"
+        assert 'scenario="e5.fork"' in text, (
+            "scenario correlation label missing from watchdog trips"
+        )
+        for name, ok in report.summary().items():
+            assert ok, name
+        emit(
+            "gameday_p99_ms", round(result["p99_ms"], 3), "ms",
+            smoke=True,
+            seed=seed,
+            invariants={n: bool(v) for n, v in report.summary().items()},
+            events_fired=[e for e, _ in result["events_fired"]],
+            faults_fired=result["faults"]["fired"],
+            ryw_violations=0,
+            epoch=result["epoch"],
+            exposition_families_ok=True,
+            scenario_label_ok=True,
+            chrome_trace=trace_path,
+        )
+        return
+
+    emit(
+        "gameday_p99_ms", round(result["p99_ms"], 3), "ms",
+        seed=seed,
+        p99_floor_ms=round(result["floor_ms"], 3),
+        p99_budget="5.0x floor",
+        invariants={n: bool(v) for n, v in report.summary().items()},
+        events_fired=[e for e, _ in result["events_fired"]],
+        faults_fired=result["faults"]["fired"],
+        faults_by_kind=result["faults"]["byKind"],
+        overload_completed=result["overload"].ok,
+        overload_shed=result["overload"].shed,
+        ryw_violations=0,
+        epoch=result["epoch"],
+        join=result["join"],
+        survivor=result["survivor"],
+        chrome_trace=trace_path,
+        note="one seeded timeline: shard join + collector death + "
+             "replica death + shard death + fork battle under 4x "
+             "MIXED overload; gated on RYW + retraction + token "
+             "floors + exactly-old-or-new epoch + bit-exact roots + "
+             "admitted p99 <= 5x floor (docs/gameday.md)",
+    )
+
+
 def bench_ingest(smoke=False, deadline_s=180.0):
     """``bench.py --ingest``: the Kesque storage-engine gate — three
     first-class metrics, all gated:
@@ -3231,6 +3763,17 @@ def main() -> None:
         return
     if "--ingest" in sys.argv:
         bench_ingest(smoke="--smoke" in sys.argv)
+        return
+    if "--gameday" in sys.argv:
+        seed = 0
+        chrome_out = None
+        for arg in sys.argv[1:]:
+            if arg.startswith("--seed="):
+                seed = int(arg.split("=", 1)[1])
+            elif arg.startswith("--chrome-out="):
+                chrome_out = arg.split("=", 1)[1]
+        bench_gameday(smoke="--smoke" in sys.argv, seed=seed,
+                      chrome_out=chrome_out)
         return
     compare_path = None
     diff_path = None

@@ -57,9 +57,59 @@ __all__ = [
     "uninstall",
     "active",
     "apply_config",
+    "merge_plans",
+    "KNOWN_SEAMS",
+    "known_seam",
 ]
 
 KINDS = ("raise", "latency", "corrupt", "die")
+
+# Canonical registry of every fault seam in the tree — the chaos
+# analog of profiler.KNOWN_SITES. A seam name ending in ``*`` is a
+# prefix pattern for parameterised seams (``cluster.call:{endpoint}``).
+# tests/test_gameday.py's seam audit walks every ``fault_point`` /
+# ``fault_value`` call in khipu_tpu/ and fails if a seam is missing
+# here OR referenced by no test, so a new seam cannot silently ship
+# unregistered or unexercised.
+KNOWN_SEAMS = frozenset({
+    # ledger / window collector stage boundaries (sync/replay.py,
+    # ledger/window.py, ledger/batch_*.py)
+    "ledger.batch",
+    "collector.seal", "collector.pack", "collector.collect",
+    "collector.persist", "collector.save", "collector.commit",
+    "collector.spill",
+    # storage datasources (storage/datasource.py)
+    "storage.kv.get", "storage.kv.put",
+    "storage.node.get", "storage.node.put",
+    "storage.block.get", "storage.block.put",
+    # log-structured store (storage/kesque.py, storage/segment.py,
+    # sync/fast_sync.py)
+    "kesque.append", "kesque.roll", "kesque.index",
+    "kesque.compact", "kesque.ingest",
+    # bridge RPC plane (bridge.py)
+    "bridge.node.value", "bridge.segment.raw",
+    "bridge.call.*", "bridge.serve.*",
+    # reorg two-phase switch (sync/reorg.py)
+    "reorg.intent", "reorg.rollback", "reorg.adopt", "reorg.finalize",
+    # shard cluster (cluster/client.py, cluster/rebalance.py)
+    "cluster.call:*", "cluster.fetch.value", "cluster.replicate",
+    "rebalance.plan", "rebalance.stream", "rebalance.cutover",
+    "rebalance.retire",
+    # serving plane (serving/replica.py, serving/fleet.py)
+    "replica.tail", "fleet.route",
+    # fused device dispatch (trie/fused.py)
+    "fused.dispatch", "fused.collect",
+})
+
+
+def known_seam(site: str) -> bool:
+    """True when ``site`` is registered in ``KNOWN_SEAMS`` exactly or
+    via a ``prefix*`` pattern."""
+    if site in KNOWN_SEAMS:
+        return True
+    return any(
+        p.endswith("*") and site.startswith(p[:-1]) for p in KNOWN_SEAMS
+    )
 
 
 class InjectedFault(Exception):
@@ -173,10 +223,16 @@ class FaultPlan:
 
     Determinism contract: per-site hit counters advance on every hit;
     each (rule, site) pair draws from its OWN ``random.Random`` seeded
-    from ``keccak256(f"{seed}:{rule_index}:{site}")`` — independent of
-    dict order, thread interleaving across DIFFERENT sites, and of any
-    other rule. Replaying the same workload with the same seed fires
-    the same (site, hit, kind) sequence.
+    from ``keccak256(f"{key_seed}:{key_index}:{site}")`` — independent
+    of dict order, thread interleaving across DIFFERENT sites, and of
+    any other rule. Replaying the same workload with the same seed
+    fires the same (site, hit, kind) sequence.
+
+    A rule's RNG key is ``(seed, position)`` as seen by the plan that
+    ORIGINALLY carried the rule — ``merge_plans`` preserves the parts'
+    keys, so a rule's draw stream never changes just because another
+    plan's rules were concatenated in front of it (the aliasing bug
+    that naive ``FaultPlan(seed, a.rules + b.rules)`` composition has).
     """
 
     def __init__(self, seed: int = 0, rules: Optional[List[FaultRule]] = None,
@@ -188,6 +244,14 @@ class FaultPlan:
         self._hits: Dict[str, int] = {}
         self._fire_counts: Dict[int, int] = {}
         self._rngs: Dict[Tuple[int, str], object] = {}
+        # per-rule RNG key: (origin seed, origin position). Stable
+        # across merge_plans/extend — THE per-(rule, site) independence
+        # anchor.
+        self._rule_keys: List[Tuple[int, int]] = [
+            (self.seed, i) for i in range(len(self.rules))
+        ]
+        # next origin position for rules this plan mints itself
+        self._next_own = len(self.rules)
         # every fired fault, in fire order: (site, hit, kind, rule idx)
         self.fired: List[Tuple[str, int, str, int]] = []
 
@@ -201,8 +265,9 @@ class FaultPlan:
         key = (rule_index, site)
         rng = self._rngs.get(key)
         if rng is None:
+            kseed, kidx = self._rule_keys[rule_index]
             digest = keccak256(
-                f"{self.seed}:{rule_index}:{site}".encode()
+                f"{kseed}:{kidx}:{site}".encode()
             )
             rng = self._rngs[key] = random.Random(
                 int.from_bytes(digest[:8], "big")
@@ -212,6 +277,19 @@ class FaultPlan:
     def hits(self, site: str) -> int:
         with self._lock:
             return self._hits.get(site, 0)
+
+    def extend(self, rules: List[FaultRule]) -> None:
+        """Append rules at runtime (the scenario engine arms hazards at
+        progress milestones this way). New rules key their RNG streams
+        from this plan's own ``(seed, next position)`` sequence, so a
+        plan built up by ``extend`` draws identically to one
+        constructed with every rule up front."""
+        rules = tuple(rules)
+        with self._lock:
+            for _ in rules:
+                self._rule_keys.append((self.seed, self._next_own))
+                self._next_own += 1
+            self.rules = self.rules + rules
 
     # --------------------------------------------------------------- fire
 
@@ -258,6 +336,40 @@ class FaultPlan:
                     f"injected death at {site} (hit {hit}, rule {i})"
                 )
         return value
+
+
+def merge_plans(*plans: FaultPlan, sleep=None) -> FaultPlan:
+    """Compose plans into ONE installable plan whose injection
+    schedule is the union of the parts'.
+
+    Each rule keeps the RNG key ``(origin seed, origin position)`` it
+    had in the plan it came from, so its per-site draw stream — and
+    therefore every probabilistic fire decision — is bit-identical to
+    what it would have been running its part alone over the same
+    workload. Naive composition (``FaultPlan(seed, a.rules + b.rules)``)
+    re-indexes b's rules and re-seeds them under a's seed, aliasing
+    their streams onto different draws.
+
+    Merge BEFORE installing: hit counters, fire counts and the
+    ``fired`` log start fresh on the merged plan. The merged plan's
+    own ``seed`` (used by later ``extend`` calls) is the first part's.
+    """
+    if not plans:
+        return FaultPlan()
+    merged = FaultPlan(
+        seed=plans[0].seed, sleep=sleep or plans[0]._sleep
+    )
+    rules: List[FaultRule] = []
+    keys: List[Tuple[int, int]] = []
+    for p in plans:
+        rules.extend(p.rules)
+        keys.extend(p._rule_keys)
+    merged.rules = tuple(rules)
+    merged._rule_keys = keys
+    merged._next_own = 1 + max(
+        (idx for (s, idx) in keys if s == merged.seed), default=-1
+    )
+    return merged
 
 
 # THE installed plan. ``None`` (the default) keeps both seams below at

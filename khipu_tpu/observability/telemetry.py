@@ -51,7 +51,7 @@ import threading
 import time
 from collections import deque
 from random import Random
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from khipu_tpu.base.rlp import rlp_decode, rlp_encode
 from khipu_tpu.config import TelemetryConfig
@@ -616,6 +616,9 @@ class Watchdog:
         self.tracer = tracer
         self._clock = clock
         self.trips: Dict[str, int] = {k: 0 for k in WATCHDOG_KINDS}
+        # (kind, scenario_event_id) -> trips attributed to an injected
+        # gameday hazard (chaos/scenario.py correlation)
+        self.scenario_trips: Dict[Tuple[str, str], int] = {}
         self.events: deque = deque(maxlen=64)  # (kind, tags) recent
         self._stage: Dict[str, dict] = {}
         self._journal_over = False
@@ -647,6 +650,21 @@ class Watchdog:
 
     def _trip(self, kind: str, **tags) -> None:
         self.trips[kind] = self.trips.get(kind, 0) + 1
+        # gameday correlation: when a chaos scenario is live, stamp
+        # the most recent injected event's id onto the trip so the
+        # trip is attributable to the hazard that (most plausibly)
+        # caused it — surfaced as khipu_watchdog_trips_total{kind=,
+        # scenario=} beside the unlabeled-by-scenario base family.
+        try:
+            from khipu_tpu.chaos.scenario import current_event_id
+
+            scenario = current_event_id()
+        except Exception:  # pragma: no cover - chaos layer optional
+            scenario = None
+        if scenario is not None:
+            tags = dict(tags, scenario=scenario)
+            key = (kind, scenario)
+            self.scenario_trips[key] = self.scenario_trips.get(key, 0) + 1
         self.events.append((kind, tags))
         tr = self.tracer
         if tr is not None:
@@ -867,8 +885,17 @@ class Watchdog:
     # --------------------------------------------------------- registry
 
     def _registry_samples(self) -> list:
-        return [
+        out = [
             ("khipu_watchdog_trips_total", "counter", {"kind": k},
              self.trips.get(k, 0))
             for k in WATCHDOG_KINDS
         ]
+        # scenario-attributed trips ride the same family with an extra
+        # label; the per-kind base samples above keep their exact
+        # shape so pre-gameday pins stay byte-stable
+        for (kind, scenario), n in sorted(self.scenario_trips.items()):
+            out.append((
+                "khipu_watchdog_trips_total", "counter",
+                {"kind": kind, "scenario": scenario}, n,
+            ))
+        return out

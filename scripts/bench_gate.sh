@@ -59,6 +59,9 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --ingest --smoke
 echo "== fleet serve smoke (a stale read under a consistent-read token, an unmirrored reorg, or a missing khipu_fleet_* family fails the gate) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --serve --http --smoke
 
+echo "== gameday smoke (the composed failure timeline: any RYW/retraction/token-floor/epoch/roots invariant, a missing khipu_gameday_* family, or an unlabeled watchdog trip fails the gate) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --gameday --smoke
+
 echo "== bench regression gate (baseline: $BASELINE) =="
 # --diff: on a failure (or any movement past tolerance) print the
 # differential attribution — WHICH phase/sub-phase site moved and by
