@@ -36,8 +36,23 @@ import time
 # a captured baseline without re-parsing our own stdout
 _EMITTED = []
 
+# --compare context: while a baseline is loaded, emit() fills
+# vs_baseline with the REAL ratio against the captured line (host-speed
+# normalized for rate units) instead of the historical 0.0 placeholder
+_BASELINE_CTX = {"map": None, "speed_adjust": None}
+
 
 def emit(metric, value, unit, vs_baseline=0.0, **extra):
+    if vs_baseline == 0.0 and _BASELINE_CTX["map"] is not None:
+        base = _BASELINE_CTX["map"].get(metric)
+        bval = base.get("value") if isinstance(base, dict) else None
+        if isinstance(bval, (int, float)) and bval:
+            # rate metrics ("/s") compare host-speed-adjusted, the same
+            # normalization _compare_line gates on; durations/fractions
+            # compare raw (the ratio is the trajectory, not a gate)
+            adj = ((_BASELINE_CTX["speed_adjust"] or 1.0)
+                   if "/s" in str(unit) else 1.0)
+            vs_baseline = round(value * adj / bval, 3)
     line = {
         "metric": metric,
         "value": value,
@@ -47,6 +62,21 @@ def emit(metric, value, unit, vs_baseline=0.0, **extra):
     line.update(extra)
     _EMITTED.append(line)
     print(json.dumps(line), flush=True)
+
+
+def _quantile(vals, q):
+    s = sorted(vals)
+    if not s:
+        return 0.0
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _p50(vals):
+    return _quantile(vals, 0.50)
+
+
+def _p99(vals):
+    return _quantile(vals, 0.99)
 
 
 def cpu_scalar_baseline(length: int = 576, iters: int = 20000) -> float:
@@ -1595,10 +1625,18 @@ def bench_compare(path, thresholds=None, runners=None, diff=False):
             # ISSUE 17 fixture: mapping-write-dominated ERC-20 traffic
             # (no pre-r11 baseline entry; tolerated the same way)
             bench_replay_erc20_heavy,
+            # ISSUE 20 fixture: eth_getLogs indexing scans (no pre-r12
+            # baseline entry; tolerated until the next capture)
+            lambda: bench_getlogs(smoke=False),
         ]
     failures = []
     comparisons = []
     LEDGER.enable()
+    # every metric line emitted under the comparison carries its real
+    # ratio against the baseline (vs_baseline was a 0.0 placeholder
+    # outside --compare runs for ten releases; see emit())
+    _BASELINE_CTX["map"] = base
+    _BASELINE_CTX["speed_adjust"] = speed_adjust
     try:
         for run in runners:
             LEDGER.reset()  # per-config movement numbers
@@ -1644,6 +1682,8 @@ def bench_compare(path, thresholds=None, runners=None, diff=False):
                 failures.extend(cmp["failures"])
     finally:
         LEDGER.disable()
+        _BASELINE_CTX["map"] = None
+        _BASELINE_CTX["speed_adjust"] = None
     emit(
         "bench_compare",
         len(failures),
@@ -1683,6 +1723,9 @@ def bench_capture(out_path, runners=None):
             bench_replay_conflict_storm,
             bench_replay_mixed_contract,
             bench_replay_erc20_heavy,
+            # indexing fixture: getlogs scan rate rides the capture so
+            # future --compare runs gate it like any blocks/s metric
+            lambda: bench_getlogs(smoke=False),
             # storage-engine gate: ingest delta vs sqlite rides the
             # capture so BENCH_rNN documents the Kesque numbers
             lambda: bench_ingest(smoke=False),
@@ -1781,8 +1824,12 @@ def _serve_setup(n_blocks, txs_per_block, window=2, depth=2):
     serve_cfg = ServingConfig(queue_timeout=0.004, max_queue=4)
     cfg = dataclasses.replace(
         fixture_config(chain_id=1),
+        # parallel_tx ON (the production default): the serve bench's
+        # import rides the conflict-aware scheduler, so tx passports
+        # carry real schedule/execute lane stamps (vector-transfer for
+        # this all-transfers fixture), not just the serial path
         sync=SyncConfig(
-            parallel_tx=False, commit_window_blocks=window,
+            parallel_tx=True, commit_window_blocks=window,
             pipeline_depth=depth,
         ),
         serving=serve_cfg,
@@ -1793,30 +1840,47 @@ def _serve_setup(n_blocks, txs_per_block, window=2, depth=2):
         bytes.fromhex("%040x" % (0xFEED0000 + i)) for i in range(32)
     ]
     alloc = {a: 10**24 for a in addrs}
-    builder = ChainBuilder(
-        Blockchain(Storages(), cfg), cfg, GenesisSpec(alloc=alloc)
-    )
-    blocks = []
-    nonces = [0] * nsenders
-    for n in range(n_blocks):
-        txs = []
-        for j in range(txs_per_block):
-            i = j % nsenders
-            txs.append(
-                sign_transaction(
-                    Transaction(
-                        nonces[i], 10**9, 21_000,
-                        receivers[(j * 7 + n) % len(receivers)],
-                        1_000 + n,
-                    ),
-                    keys[i], chain_id=1,
+    genesis = GenesisSpec(alloc=alloc)
+    # both branches share blocks 1..ancestor; the post-load fork switch
+    # retracts the base suffix so >=1 serve-bench journey crosses a
+    # reorg retraction (the passport acceptance), then adopts a longer
+    # branch whose suffix re-mines DIFFERENT txs (value offset)
+    ancestor = max(1, n_blocks - 2)
+
+    def build(total, value_off, suffix_coinbase):
+        builder = ChainBuilder(
+            Blockchain(Storages(), cfg), cfg, genesis
+        )
+        blocks, nonces = [], [0] * nsenders
+        for n in range(total):
+            diverged = n >= ancestor
+            txs = []
+            for j in range(txs_per_block):
+                i = j % nsenders
+                txs.append(
+                    sign_transaction(
+                        Transaction(
+                            nonces[i], 10**9, 21_000,
+                            receivers[(j * 7 + n) % len(receivers)],
+                            1_000 + n + (value_off if diverged else 0),
+                        ),
+                        keys[i], chain_id=1,
+                    )
                 )
-            )
-            nonces[i] += 1
-        blocks.append(builder.add_block(txs, coinbase=b"\xaa" * 20))
+                nonces[i] += 1
+            blocks.append(builder.add_block(
+                txs,
+                coinbase=suffix_coinbase if diverged else b"\xaa" * 20,
+                timestamp=10 * (n + 1),
+            ))
+        return blocks
+
+    blocks = build(n_blocks, 0, b"\xaa" * 20)
+    fork = build(n_blocks + 1, 10**6, b"\xbb" * 20)
     wire = [_Block.decode(b.encode()) for b in blocks]
+    fork_wire = [_Block.decode(b.encode()) for b in fork]
     target = Blockchain(Storages(), cfg)
-    target.load_genesis(GenesisSpec(alloc=alloc))
+    target.load_genesis(genesis)
 
     # small pool so the write backlog the load phases build (no miner
     # drains it) organically trips txpool_pressure past shed_write_at —
@@ -1881,8 +1945,8 @@ def _serve_setup(n_blocks, txs_per_block, window=2, depth=2):
         telemetry=telemetry,
     )
     server = JsonRpcServer(service, serving=plane)
-    return (cfg, target, wire, addrs, receivers, plane, service,
-            server, telemetry, watchdog)
+    return (cfg, target, wire, fork_wire, ancestor, genesis, addrs,
+            receivers, plane, service, server, telemetry, watchdog)
 
 
 def bench_serve(smoke=False):
@@ -1897,16 +1961,27 @@ def bench_serve(smoke=False):
     the unbounded thread-per-request default does)."""
     import threading
 
+    from khipu_tpu.observability.journey import JOURNEY
     from khipu_tpu.serving.loadgen import (
         MIXED,
         InProcessTransport,
         LoadGenerator,
     )
+    from khipu_tpu.serving.replica import PrimaryFeed, ReplicaDriver
     from khipu_tpu.sync.replay import ReplayDriver
 
     n_blocks = 6 if smoke else 48
-    (cfg, target, wire, addrs, receivers, plane, service,
-     server, telemetry, watchdog) = _serve_setup(n_blocks, txs_per_block=6)
+    (cfg, target, wire, fork_wire, ancestor, genesis, addrs, receivers,
+     plane, service, server, telemetry,
+     watchdog) = _serve_setup(n_blocks, txs_per_block=6)
+    # the tx passport rides the whole bench: every import, pool, lane,
+    # seal, durable, reorg, and replica-visibility edge is stamped
+    JOURNEY.reset()
+    JOURNEY.enable()
+    # one read replica tails the primary's durable chain throughout —
+    # its replica.visible stamps feed the ingress->replica_visible SLO
+    replica = ReplicaDriver("r1", PrimaryFeed(target), cfg,
+                            genesis).start()
     transport = InProcessTransport(server)
     nonce_addrs = ["0x" + a.hex() for a in addrs]
     # balances are checked on ACCUMULATE-ONLY addresses (receivers +
@@ -1972,6 +2047,95 @@ def bench_serve(smoke=False):
                    0x0C33_0000).run()
     overload_mid_sync = not sync_done.is_set()
     sync_thread.join(timeout=120)
+
+    # ---- the tx passport acceptance. The primary switches to the
+    # longer fork branch (load is done, so the RYW checker's monotone
+    # assumption is not in play): the base suffix RETRACTS under live
+    # journeys, then the replica mirrors the switch. After that, the
+    # lineage plane must answer for every fixture tx: a complete,
+    # monotonically ordered event list, >=1 journey crossing the
+    # retraction, >=1 that rode the vectorized transfer lane
+    from khipu_tpu.sync.reorg import ReorgManager
+
+    reorg = ReorgManager(target, cfg, driver=driver,
+                         read_view=plane.read_view)
+    reorg.switch(ancestor, fork_wire[ancestor:])
+    fork_tip = len(fork_wire)
+    assert target.best_block_number == fork_tip
+    deadline = time.perf_counter() + 60
+    while (time.perf_counter() < deadline
+           and replica.head_number() < fork_tip):
+        time.sleep(0.02)
+    assert replica.head_number() == fork_tip, replica.snapshot()
+    replica.stop()
+
+    all_hashes = [stx.hash for b in wire
+                  for stx in b.body.transactions]
+    complete = 0
+    retract_crossing = 0
+    for h in all_hashes:
+        ex = JOURNEY.export(h)
+        if ex is None:
+            continue
+        ts = [e["t"] for e in ex["events"]]
+        edges = [e["edge"] for e in ex["events"]]
+        if ts != sorted(ts):
+            continue  # out-of-order passport: not complete
+        if "ingress" in edges and "durable" in edges:
+            complete += 1
+        if "reorg.retract" in edges:
+            retract_crossing += 1
+    coverage = complete / len(all_hashes)
+    vector_lane = sum(
+        1 for j in JOURNEY.journeys()
+        for (_t, e, _n, _tid, d) in j.events
+        if e == "execute" and d and d.get("lane") == "vector-transfer"
+    )
+    assert coverage >= 0.99, (
+        f"journey coverage {coverage:.4f} < 0.99 "
+        f"({complete}/{len(all_hashes)} complete)"
+    )
+    assert retract_crossing >= 1, (
+        "no journey crossed the reorg retraction"
+    )
+    assert vector_lane >= 1, "no journey rode the vector lane"
+    # the RPC surface serves the same passport, ordered
+    retracted_h = next(
+        h for h in all_hashes
+        if (j := JOURNEY.get(h)) is not None
+        and any(e[1] == "reorg.retract" for e in j.events)
+    )
+    rpc_j = service.khipu_tx_journey("0x" + retracted_h.hex())
+    rpc_edges = [e["edge"] for e in rpc_j["events"]]
+    assert "reorg.retract" in rpc_edges, rpc_edges
+    assert rpc_edges.index("ingress") < rpc_edges.index("durable"), (
+        rpc_edges
+    )
+
+    durable_ms = JOURNEY.latencies_ms("durable")
+    visible_ms = JOURNEY.latencies_ms("replica.visible")
+    assert durable_ms, "no ingress->durable journey latencies"
+    assert visible_ms, "no ingress->replica_visible journey latencies"
+    emit(
+        "tx_ingress_to_durable_p99_ms",
+        round(_p99(durable_ms), 3), "ms",
+        samples=len(durable_ms),
+        p50_ms=round(_p50(durable_ms), 3),
+        journey_coverage=round(coverage, 4),
+        journeys_retracted=retract_crossing,
+        vector_lane_executes=vector_lane,
+        note="per-tx passport: first ingress stamp to the window's "
+             "crash-survivable commit mark (throttled import — the "
+             "number includes the deliberate window pacing)",
+    )
+    emit(
+        "tx_ingress_to_replica_visible_p99_ms",
+        round(_p99(visible_ms), 3), "ms",
+        samples=len(visible_ms),
+        p50_ms=round(_p50(visible_ms), 3),
+        note="first ingress stamp to a replica tail passing the tx's "
+             "block — the fleet's consistent-read promise, per tx",
+    )
 
     violations = (
         len(mixed.violations) + len(overload.violations)
@@ -2072,6 +2236,23 @@ def bench_serve(smoke=False):
         ):
             n = text.count(f"# TYPE {fam} gauge")
             assert n == 1, f"{fam} TYPE lines: {n}"
+        # tx passport families: the commit-latency histogram (one TYPE
+        # line covering both edge= children) and the journey board's
+        # registry collector
+        for fam, kind in (
+            ("khipu_tx_commit_latency_seconds", "histogram"),
+            ("khipu_tx_journey_enabled", "gauge"),
+            ("khipu_tx_journeys_tracked", "gauge"),
+            ("khipu_tx_journeys_pinned", "gauge"),
+            ("khipu_tx_journey_events_total", "counter"),
+            ("khipu_tx_journeys_evicted_total", "counter"),
+        ):
+            n = text.count(f"# TYPE {fam} {kind}")
+            assert n == 1, f"{fam} TYPE lines: {n}"
+        assert 'edge="durable"' in text, "durable histogram child missing"
+        assert 'edge="replica_visible"' in text, (
+            "replica_visible histogram child missing"
+        )
         assert 'khipu_watchdog_trips_total{kind="journal_runaway"} 1' \
             in text, text
         ctext = service.khipu_cluster_metrics_text()
@@ -3007,6 +3188,14 @@ def _gameday_run(smoke, seed, result):
     from khipu_tpu.storage.storages import Storages
     from khipu_tpu.sync.replay import PIPELINE_GAUGES, ReplayDriver
 
+    from khipu_tpu.observability.journey import JOURNEY
+
+    # tx passports ride the whole gameday: the fork battle's
+    # retractions, the replica tails' visibility stamps and the
+    # commit-latency histograms (with exemplar trace ids — the flight
+    # recorder is on for the run) are all part of the postmortem
+    JOURNEY.reset()
+    JOURNEY.enable()
     n_blocks = 10 if smoke else 48
     (cfg, target, wire, fork_wire, ancestor, addrs, receivers, plane,
      service, server, driver, reorg, replicas, telemetry, router,
@@ -3313,7 +3502,20 @@ def _gameday_run(smoke, seed, result):
     assert "journal_runaway" in tripped, tripped
     snap = fault_log.snapshot()
 
+    # per-tx passport readout: commit-latency tails plus the count of
+    # journeys that crossed the fork battle's retraction — gated in
+    # bench_gameday (a gameday whose passports miss the reorg would be
+    # lying about what the timeline did)
+    durable_ms = JOURNEY.latencies_ms("durable")
+    visible_ms = JOURNEY.latencies_ms("replica.visible")
+    retracted_journeys = sum(
+        1 for j in JOURNEY.journeys()
+        if any(e[1] == "reorg.retract" for e in j.events)
+    )
     result.update({
+        "tx_durable_ms": durable_ms,
+        "tx_visible_ms": visible_ms,
+        "retracted_journeys": retracted_journeys,
         "report": report,
         "p99_ms": p99_ms,
         "floor_ms": floor_ms,
@@ -3405,6 +3607,37 @@ def bench_gameday(smoke=False, seed=0, deadline_s=None,
                   file=sys.stderr)
         sys.exit(1)
 
+    # passport SLO lines, gated: the board must have witnessed durable
+    # commits, replica visibility AND the fork battle's retractions
+    durable_ms = result["tx_durable_ms"]
+    visible_ms = result["tx_visible_ms"]
+    retracted = result["retracted_journeys"]
+    for name, ok in (
+        ("tx durable latencies", bool(durable_ms)),
+        ("tx replica-visible latencies", bool(visible_ms)),
+        ("retraction-crossing journeys", retracted >= 1),
+    ):
+        if not ok:
+            print(f"bench_gameday: FAILED — passport gate: no {name}",
+                  file=sys.stderr)
+            sys.exit(1)
+    emit(
+        "tx_ingress_to_durable_p99_ms",
+        round(_p99(durable_ms), 3), "ms",
+        samples=len(durable_ms),
+        p50_ms=round(_p50(durable_ms), 3),
+        retracted_journeys=retracted,
+        note="per-tx passport across the whole gameday timeline "
+             "(import deliberately throttled to stretch the hazard "
+             "window — pacing is in the number)",
+    )
+    emit(
+        "tx_ingress_to_replica_visible_p99_ms",
+        round(_p99(visible_ms), 3), "ms",
+        samples=len(visible_ms),
+        p50_ms=round(_p50(visible_ms), 3),
+    )
+
     if smoke:
         # exposition: every gameday family exactly once, plus the
         # watchdog correlation label stamped by the scenario
@@ -3416,9 +3649,20 @@ def bench_gameday(smoke=False, seed=0, deadline_s=None,
             ("khipu_gameday_invariant_checks_total", "counter"),
             ("khipu_gameday_invariant_failures_total", "counter"),
             ("khipu_gameday_last_p99_ms", "gauge"),
+            ("khipu_tx_commit_latency_seconds", "histogram"),
+            ("khipu_tx_journey_enabled", "gauge"),
+            ("khipu_tx_journeys_tracked", "gauge"),
+            ("khipu_tx_journeys_pinned", "gauge"),
+            ("khipu_tx_journey_events_total", "counter"),
+            ("khipu_tx_journeys_evicted_total", "counter"),
         ):
             n = text.count(f"# TYPE {fam} {kind}")
             assert n == 1, f"{fam} TYPE lines: {n}"
+        # exemplar linkage: the flight recorder was ON for the run, so
+        # commit-latency buckets carry the owning trace id
+        assert ' # {trace_id="' in text, (
+            "no exemplar on the commit-latency histogram"
+        )
         assert 'khipu_watchdog_trips_total{kind="journal_runaway"' \
             in text, "watchdog trip family missing"
         assert 'scenario="e5.fork"' in text, (
@@ -3748,6 +3992,244 @@ def bench_ingest(smoke=False, deadline_s=180.0):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_conformance(gate=1.0):
+    """``bench.py --conformance``: run the GeneralStateTests-format
+    corpus (tests/fixtures/state_tests — the same files the
+    pytest-marked ``conformance`` suite parametrizes over) through
+    khipu_tpu/statetest.py and gate on the pass rate. The gate is the
+    CURRENT rate (1.0): conformance only ratchets, it never regresses
+    silently."""
+    import glob
+    import os
+
+    from khipu_tpu.statetest import run_file
+
+    fixdir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "fixtures", "state_tests",
+    )
+    files = sorted(glob.glob(os.path.join(fixdir, "*.json")))
+    results = []
+    for p in files:
+        results.extend(run_file(p))
+    total = len(results)
+    passed = sum(1 for r in results if r.ok)
+    rate = passed / total if total else 0.0
+    failed = [
+        f"{r.name} [{r.fork}] idx={r.index}"
+        for r in results if not r.ok
+    ]
+    emit(
+        "statetest_pass_rate", round(rate, 4), "fraction",
+        passed=passed, total=total, files=len(files), gate=gate,
+        **({"failed": failed[:10]} if failed else {}),
+        note="ethereum/tests GeneralStateTests schema corpus via "
+             "khipu_tpu.statetest (per-fork, per-index cases)",
+    )
+    if total == 0 or rate < gate:
+        print(
+            f"bench_conformance: FAILED — pass rate {rate:.4f} < gate "
+            f"{gate} ({passed}/{total}; first failures: {failed[:3]})",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+
+def bench_getlogs(smoke=False):
+    """``bench.py --getlogs``: the indexing fixture — a chain whose
+    every block carries LOG1-emitting contract calls, scanned by
+    repeated full-range address+topic ``eth_getLogs`` queries through
+    the RPC service (the workload an indexer backfilling an event
+    table offers a node). The metric is blocks SCANNED per second;
+    every scan's hit count is verified against the fixture shape, so a
+    filter regression fails the bench rather than speeding it up."""
+    from khipu_tpu.config import fixture_config
+    from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+    from khipu_tpu.domain.transaction import (
+        Transaction,
+        contract_address,
+        sign_transaction,
+    )
+    from khipu_tpu.jsonrpc import EthService
+    from khipu_tpu.storage.storages import Storages
+    from khipu_tpu.sync.chain_builder import ChainBuilder
+
+    cfg = fixture_config(chain_id=1)
+    n_blocks = 12 if smoke else 64
+    calls_per_block = 6
+    keys, addrs = _replay_keys(4)
+    alloc = {a: 10**24 for a in addrs}
+    # runtime: PUSH32 <data> MSTORE, LOG1 topic 0x..42 with 32B data
+    topic = (0x42).to_bytes(32, "big")
+    runtime = (
+        bytes([0x7F]) + b"\xab" * 32 + bytes.fromhex("600052")
+        + bytes([0x7F]) + topic + bytes.fromhex("60206000a100")
+    )
+    init = bytes(
+        [0x60, len(runtime), 0x60, 12, 0x60, 0x00, 0x39,
+         0x60, len(runtime), 0x60, 0x00, 0xF3]
+    ) + runtime
+    bc = Blockchain(Storages(), cfg)
+    builder = ChainBuilder(bc, cfg, GenesisSpec(alloc=alloc))
+    nonces = [0] * len(keys)
+    builder.add_block(
+        [sign_transaction(
+            Transaction(0, 10**9, 300_000, None, 0, init), keys[0],
+            chain_id=1,
+        )],
+        coinbase=b"\xaa" * 20,
+    )
+    nonces[0] += 1
+    caddr = contract_address(addrs[0], 0)
+    for _n in range(n_blocks):
+        txs = []
+        for j in range(calls_per_block):
+            i = j % len(keys)
+            txs.append(sign_transaction(
+                Transaction(nonces[i], 10**9, 100_000, caddr, 0),
+                keys[i], chain_id=1,
+            ))
+            nonces[i] += 1
+        builder.add_block(txs, coinbase=b"\xaa" * 20)
+    svc = EthService(bc, cfg)
+    head = bc.best_block_number
+    query = {
+        "fromBlock": "0x0", "toBlock": "latest",
+        "address": "0x" + caddr.hex(),
+        "topics": ["0x" + topic.hex()],
+    }
+    expected = n_blocks * calls_per_block
+    assert len(svc.eth_getLogs(query)) == expected  # warm + verify
+    rounds = 3 if smoke else 10
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        hits = svc.eth_getLogs(query)
+        assert len(hits) == expected, (len(hits), expected)
+    secs = time.perf_counter() - t0
+    blocks_scanned = rounds * (head + 1)
+    emit(
+        "getlogs_blocks_per_sec",
+        round(blocks_scanned / secs, 1) if secs else 0.0,
+        "blocks/s",
+        logs_matched=expected,
+        blocks=head,
+        rounds=rounds,
+        calls_per_block=calls_per_block,
+        note="repeated full-range address+topic eth_getLogs scans "
+             "over a chain whose every block logs (receipt re-derive "
+             "+ filter path; the indexer-backfill shape)",
+    )
+
+
+def bench_history(pattern=None):
+    """``bench.py --history``: walk the committed BENCH_r*.json
+    captures and render one per-metric trajectory table across
+    releases. Rate metrics (unit contains "/s") are re-expressed in
+    the NEWEST scored capture's host frame (value * score_ref /
+    score_capture — the same normalization --compare gates on);
+    captures that predate host_speed_score print raw, marked ``*``."""
+    import glob
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(
+        glob.glob(pattern or os.path.join(here, "BENCH_r*.json"))
+    )
+    caps = []
+    for p in paths:
+        try:
+            caps.append((
+                os.path.basename(p)
+                .replace("BENCH_", "").replace(".json", ""),
+                parse_baseline(p),
+            ))
+        except Exception as e:  # noqa: BLE001 - skip broken captures
+            print(f"bench_history: skipping {p}: {e}", file=sys.stderr)
+    if not caps:
+        print("bench_history: no BENCH_r*.json captures found",
+              file=sys.stderr)
+        sys.exit(1)
+    scores = {
+        name: (m.get("host_speed_score") or {}).get("value")
+        for name, m in caps
+    }
+    ref_name = ref_score = None
+    for name, _m in reversed(caps):
+        if scores[name]:
+            ref_name, ref_score = name, scores[name]
+            break
+    metrics, units = [], {}
+    for _name, m in caps:
+        for k, line in m.items():
+            if k in ("host_speed_score", "bench_compare"):
+                continue
+            if k not in units:
+                metrics.append(k)
+                units[k] = str(line.get("unit", ""))
+    table = {}
+    for k in metrics:
+        row = {}
+        for name, m in caps:
+            line = m.get(k)
+            v = line.get("value") if isinstance(line, dict) else None
+            if not isinstance(v, (int, float)):
+                continue
+            normalized = False
+            if "/s" in units[k] and ref_score and scores[name]:
+                v = v * ref_score / scores[name]
+                normalized = True
+            row[name] = (v, normalized)
+        table[k] = row
+
+    def fmt(v, normalized, is_rate):
+        s = f"{v:,.4g}"
+        if is_rate and ref_score and not normalized:
+            s += "*"
+        return s
+
+    names = [n for n, _ in caps]
+    mw = max(len(k) for k in metrics) + 2
+    colw = {
+        n: max(
+            [len(n)] + [
+                len(fmt(*table[k][n], "/s" in units[k]))
+                for k in metrics if n in table[k]
+            ]
+        ) + 2
+        for n in names
+    }
+    head = (f"bench history — {len(caps)} captures"
+            + (f"; rates in {ref_name}'s host frame "
+               f"(host_speed_score {ref_score:,.0f})" if ref_score
+               else "; no scored capture, all values raw"))
+    print(head)
+    header = "metric".ljust(mw) + "unit".ljust(10) + "".join(
+        n.rjust(colw[n]) for n in names
+    )
+    print(header)
+    print("-" * len(header))
+    for k in metrics:
+        is_rate = "/s" in units[k]
+        cells = "".join(
+            ("-" if n not in table[k]
+             else fmt(*table[k][n], is_rate)).rjust(colw[n])
+            for n in names
+        )
+        print(k.ljust(mw) + units[k][:9].ljust(10) + cells)
+    if ref_score:
+        print("* raw: capture predates host_speed_score "
+              "(no cross-host normalization possible)")
+    emit(
+        "bench_history", len(caps), "captures",
+        reference=ref_name,
+        reference_host_speed_score=ref_score,
+        metrics={
+            k: {n: round(v, 4) for n, (v, _norm) in table[k].items()}
+            for k in metrics
+        },
+    )
+
+
 def main() -> None:
     if "--serve" in sys.argv:
         if "--http" in sys.argv:
@@ -3763,6 +4245,15 @@ def main() -> None:
         return
     if "--ingest" in sys.argv:
         bench_ingest(smoke="--smoke" in sys.argv)
+        return
+    if "--conformance" in sys.argv:
+        bench_conformance()
+        return
+    if "--getlogs" in sys.argv:
+        bench_getlogs(smoke="--smoke" in sys.argv)
+        return
+    if "--history" in sys.argv:
+        bench_history()
         return
     if "--gameday" in sys.argv:
         seed = 0

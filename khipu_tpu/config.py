@@ -229,6 +229,20 @@ class ObservabilityConfig:
     # when set, bench --trace / ServiceBoard dump Chrome trace_event
     # JSON (perfetto-loadable) here on demand
     chrome_trace_path: Optional[str] = None
+    # per-transaction lineage plane (observability/journey.py — the
+    # "tx passport"): bounded per-tx lifecycle event records keyed by
+    # tx hash, served by the khipu_tx_journey RPC. Same zero-cost
+    # contract: off by default, every seam one attribute load + branch
+    journey_enabled: bool = False
+    journey_capacity: int = 4096  # happy-path journeys (drop-oldest)
+    journey_pinned_capacity: int = 1024  # tail-retained journeys
+    # deterministic head-sampling in the tx hash (journey_sampled):
+    # keep N in 10_000 happy-path journeys; pinned classes (shed,
+    # mispredicted, retracted, rolled-back, slow) always tracked
+    journey_sample_per_10k: int = 10_000
+    journey_max_events: int = 64  # per-journey event cap
+    # ingress->durable beyond this budget pins the journey (slow tail)
+    journey_slow_ms: float = 250.0
 
 
 @dataclass(frozen=True)

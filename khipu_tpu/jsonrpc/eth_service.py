@@ -422,6 +422,18 @@ class EthService:
         stx = SignedTransaction.decode(parse_data(raw))
         if stx.sender is None:
             raise RpcError(-32000, "invalid signature")
+        from khipu_tpu.observability.journey import JOURNEY
+
+        if JOURNEY.enabled:
+            # passport ingress: the tx entered through the serving
+            # plane — the trace id of the serving ring rides along so
+            # the journey links into the merged chrome trace
+            JOURNEY.record(
+                stx.hash, "ingress", source="rpc",
+                trace_id=(self.tracer.trace_id
+                          if self.tracer is not None
+                          and self.tracer.enabled else None),
+            )
         if not self.tx_pool.add(stx):
             # geth parity: a rejected add is an ERROR, not a silent
             # hash — the wallet must know its tx is not in the pool
@@ -672,6 +684,28 @@ class EthService:
 
         n = parse_qty(number) if isinstance(number, str) else int(number)
         return export.trace_block(n, tracer_=self.tracer)
+
+    def khipu_tx_journey(self, tx_hash) -> dict:
+        """One transaction's passport (observability/journey.py): the
+        ordered lifecycle events it crossed — ingress, pool, schedule
+        decision (batch + lane), execute lane, seal, journal-intent,
+        durable, reorg retraction/re-inclusion, per-replica visibility
+        — each with a monotonic timestamp, absolute wall time, the
+        stamping node, and the owning flight-recorder trace id (the
+        exemplar link into the merged chrome trace)."""
+        from khipu_tpu.observability.journey import JOURNEY
+
+        if not JOURNEY.enabled:
+            raise RpcError(-32000, "tx journeys not enabled")
+        h = parse_data(tx_hash) if isinstance(tx_hash, str) else tx_hash
+        rec = JOURNEY.export(h)
+        if rec is None:
+            raise RpcError(
+                -32000,
+                "no journey for this tx (evicted, unsampled, or "
+                "never seen)",
+            )
+        return rec
 
     def khipu_window_report(self, number) -> dict:
         """Data-movement record of the window containing block ``n``:

@@ -59,6 +59,7 @@ from khipu_tpu.ledger.schedule import (
     apply_effect,
     predict_call_gas,
 )
+from khipu_tpu.observability.journey import JOURNEY
 
 
 def execute_call_batch(
@@ -142,4 +143,8 @@ def execute_call_batch(
     # a stale touch mark would surface in the NEXT interpreter tx's
     # sweep as an out-of-footprint account read
     world.touched.clear()
+    if JOURNEY.enabled:
+        for index, stx, _sender, _ch, _tpl in items:
+            JOURNEY.record(stx.hash, "execute",
+                           lane="vector-call", index=index)
     return results

@@ -47,6 +47,7 @@ from khipu_tpu.base.crypto.secp256k1 import HALF_N
 from khipu_tpu.chaos.plan import fault_point
 from khipu_tpu.domain.account import EMPTY_CODE_HASH
 from khipu_tpu.ledger.schedule import Misprediction
+from khipu_tpu.observability.journey import JOURNEY
 
 _U64 = (1 << 64) - 1
 _U256 = 1 << 256
@@ -205,4 +206,8 @@ def execute_fast_batch(
     # whose get_account probes would then escape that tx's predicted
     # footprint
     world.touched.clear()
+    if JOURNEY.enabled:
+        for index, stx, _sender in items:
+            JOURNEY.record(stx.hash, "execute",
+                           lane="vector-transfer", index=index)
     return results

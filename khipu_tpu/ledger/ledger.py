@@ -55,6 +55,7 @@ from khipu_tpu.evm.vm import BlockEnv, MessageEnv
 from khipu_tpu.ledger.bloom import bloom_of_logs, bloom_union
 from khipu_tpu.ledger.rewards import block_rewards
 from khipu_tpu.ledger.world import BlockWorldState
+from khipu_tpu.observability.journey import JOURNEY
 from khipu_tpu.observability.profiler import HOST, LEDGER
 
 
@@ -434,7 +435,18 @@ def execute_block(
                     if isinstance(e, Misprediction):
                         stats.mispredicted_txs += 1
                         EXEC_GAUGES["mispredictions"] += 1
+                        if JOURNEY.enabled and e.index < len(txs):
+                            JOURNEY.record(txs[e.index].hash,
+                                           "mispredict",
+                                           reason=e.detail,
+                                           block=header.number)
                     EXEC_GAUGES["fallbacks"] += 1
+                    if JOURNEY.enabled:
+                        for stx in txs:
+                            JOURNEY.record(stx.hash, "execute",
+                                           lane="serial-fallback",
+                                           rerun=True,
+                                           block=header.number)
                     stats.parallel_count = 0
                     stats.conflict_count = 0
                     stats.fast_path_txs = 0
@@ -450,6 +462,12 @@ def execute_block(
                     stats.mispredicted_txs += 1
                     EXEC_GAUGES["mispredictions"] += 1
                     EXEC_GAUGES["fallbacks"] += 1
+                    if JOURNEY.enabled:
+                        for stx in txs:
+                            JOURNEY.record(stx.hash, "execute",
+                                           lane="serial-fallback",
+                                           rerun=True,
+                                           block=header.number)
                     stats.parallel_count = 0
                     stats.conflict_count = 0
                     stats.fast_path_txs = 0
@@ -641,6 +659,9 @@ def _execute_scheduled(
                 duration=time.perf_counter() - _t0,
             )
             stats.residue_txs += 1
+            if JOURNEY.enabled:
+                JOURNEY.record(txs[i].hash, "execute",
+                               lane="residue", index=i)
             if (
                 code_hash is not None
                 and code_hash != EMPTY_CODE_HASH
@@ -710,6 +731,9 @@ def _execute_scheduled(
                     )
                 stats.parallel_count += 1
                 EXEC_GAUGES["checked_call_txs"] += 1
+                if JOURNEY.enabled:
+                    JOURNEY.record(txs[i].hash, "execute",
+                                   lane="checked", index=i)
                 if (confirm_keys is not None
                         and outcomes[i].error is None
                         and outcomes[i].status == 1):

@@ -86,6 +86,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 from khipu_tpu.base.crypto.keccak import keccak256
 from khipu_tpu.domain.account import EMPTY_CODE_HASH
 from khipu_tpu.domain.transaction import contract_address
+from khipu_tpu.observability.journey import JOURNEY
 from khipu_tpu.ledger.world import (
     ON_ACCOUNT,
     ON_ADDRESS,
@@ -1072,6 +1073,24 @@ def plan_block(txs: Sequence, senders: Sequence[Optional[bytes]],
     )
     if plan.max_width > EXEC_GAUGES["max_batch_width"]:
         EXEC_GAUGES["max_batch_width"] = plan.max_width
+    if JOURNEY.enabled:
+        # the passport's "schedule" page: the DECISION (predicted lane
+        # + batch id), stamped before any execution — the execute stamp
+        # later records the lane that actually ran
+        for step_i, step in enumerate(plan.steps):
+            for i in step.indices:
+                if step.kind == RESIDUE:
+                    lane = "residue"
+                else:
+                    pred = plan.predicted[i]
+                    if pred.kind == FAST:
+                        lane = "vector-transfer"
+                    elif i in plan.trusted:
+                        lane = "vector-call"
+                    else:
+                        lane = "checked"
+                JOURNEY.record(txs[i].hash, "schedule",
+                               batch=step_i, lane=lane)
     return plan
 
 

@@ -221,9 +221,13 @@ class WindowCommitter:
 
     # ------------------------------------------------------------ commit
 
-    def commit_block(self, world: BlockWorldState, header: BlockHeader) -> None:
+    def commit_block(self, world: BlockWorldState, header: BlockHeader,
+                     txs: Optional[list] = None) -> None:
         """Fold one executed block's world into the window session
-        (the deferred analog of world.flush)."""
+        (the deferred analog of world.flush). ``txs`` (the block's tx
+        hashes) rides through to ``on_block_committed`` so the serving
+        overlay can stamp per-tx visibility journeys — ``None`` when
+        the journey plane is off (the zero-cost default)."""
         final = world._materialized_accounts(hasher=None, window=self)
         trie = self.account_trie
         for addr in sorted(final):
@@ -244,7 +248,7 @@ class WindowCommitter:
             (header, trie.force_hashed_root())
         )
         if self.on_block_committed is not None:
-            self.on_block_committed(header, final)
+            self.on_block_committed(header, final, txs)
 
     def storage_session(self, root_ref) -> DeferredMPT:
         """A storage-trie session sharing the window namespace; root_ref

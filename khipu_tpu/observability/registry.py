@@ -115,7 +115,7 @@ class Histogram:
 
     kind = "histogram"
     __slots__ = ("name", "help", "labels", "buckets", "_counts",
-                 "_sum", "_count", "_lock")
+                 "_sum", "_count", "_lock", "_exemplars")
 
     def __init__(self, name: str, help: str = "",
                  labels: Optional[Dict[str, str]] = None,
@@ -130,6 +130,9 @@ class Histogram:
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        # bucket index -> (exemplar_id, observed_value); last-wins per
+        # bucket, populated only by callers that attach exemplars
+        self._exemplars: Dict[int, tuple] = {}
 
     def set_buckets(self, buckets: Sequence[float]) -> bool:
         """Re-bin to an explicit bucket layout. Only legal while empty:
@@ -140,25 +143,43 @@ class Histogram:
                 return False
             self.buckets = tuple(sorted(buckets))
             self._counts = [0] * (len(self.buckets) + 1)
+            self._exemplars = {}
             return True
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
+        """``exemplar`` (an opaque id — by convention a flight-recorder
+        trace id) is remembered per bucket, last observation wins, and
+        rides the text exposition as an OpenMetrics-style
+        ``# {trace_id="..."} v`` suffix on that bucket's line."""
         i = bisect_left(self.buckets, v)
         with self._lock:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                self._exemplars[i] = (exemplar, v)
 
     @property
     def value(self) -> dict:
         with self._lock:
             counts = list(self._counts)
             total, s = self._count, self._sum
+            ex = dict(self._exemplars)
         cum, out = 0, {}
-        for b, c in zip(self.buckets, counts):
+        exemplars = {}
+        for i, (b, c) in enumerate(zip(self.buckets, counts)):
             cum += c
             out[b] = cum
-        return {"count": total, "sum": round(s, 9), "buckets": out}
+            if i in ex:
+                exemplars[b] = ex[i]
+        if len(self.buckets) in ex:
+            exemplars["+Inf"] = ex[len(self.buckets)]
+        val = {"count": total, "sum": round(s, 9), "buckets": out}
+        if exemplars:
+            # consumers that only read count/sum/buckets (the cluster
+            # telemetry merge) skip this key untouched
+            val["exemplars"] = exemplars
+        return val
 
 
 class GaugeGroup:
@@ -242,13 +263,24 @@ def render_exposition(families) -> str:
         for labels, value in samples:
             lk = _label_key(labels)
             if kind == "histogram" and isinstance(value, dict):
+                exemplars = value.get("exemplars", {})
                 for le, cum in value["buckets"].items():
                     blk = (lk + "," if lk else "") + f'le="{le}"'
-                    lines.append(f"{name}_bucket{{{blk}}} {cum}")
+                    line = f"{name}_bucket{{{blk}}} {cum}"
+                    ex = exemplars.get(le)
+                    if ex is not None:
+                        # OpenMetrics exemplar: link the bucket to the
+                        # flight-recorder trace that produced a sample
+                        line += (f' # {{trace_id="{_escape(str(ex[0]))}"}}'
+                                 f" {ex[1]}")
+                    lines.append(line)
                 binf = (lk + "," if lk else "") + 'le="+Inf"'
-                lines.append(
-                    f"{name}_bucket{{{binf}}} {value['count']}"
-                )
+                line = f"{name}_bucket{{{binf}}} {value['count']}"
+                ex = exemplars.get("+Inf")
+                if ex is not None:
+                    line += (f' # {{trace_id="{_escape(str(ex[0]))}"}}'
+                             f" {ex[1]}")
+                lines.append(line)
                 suffix = f"{{{lk}}}" if lk else ""
                 lines.append(f"{name}_sum{suffix} {value['sum']}")
                 lines.append(f"{name}_count{suffix} {value['count']}")

@@ -464,6 +464,14 @@ def apply_config(cfg, tracer_: Optional[Tracer] = None) -> None:
         _apply_ledger(cfg)
     except Exception:
         pass
+    try:
+        from khipu_tpu.observability.journey import (
+            apply_config as _apply_journey,
+        )
+
+        _apply_journey(cfg)
+    except Exception:
+        pass
 
 
 # ring health is telemetry too: recorded/dropped/enabled for the

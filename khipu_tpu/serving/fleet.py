@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional
 
 from khipu_tpu.chaos import fault_point
 from khipu_tpu.jsonrpc.server import JsonRpcServer
+from khipu_tpu.observability.journey import JOURNEY
 from khipu_tpu.serving.replica import ReplicaDriver
 from khipu_tpu.serving.router import (
     TOKEN_KEY,
@@ -242,6 +243,23 @@ class FleetRouter:
             req = {k: v for k, v in req.items() if k != TOKEN_KEY}
         fault_point("fleet.route")
         method = req.get("method", "")
+        if method == "eth_sendRawTransaction" and JOURNEY.enabled:
+            # the fleet front is the TRUE first sighting for RPC
+            # traffic: stamp ingress here (first-wins suppresses the
+            # primary service's duplicate) so ingress->durable covers
+            # routing + admission time too
+            try:
+                from khipu_tpu.domain.transaction import (
+                    SignedTransaction,
+                )
+                from khipu_tpu.jsonrpc.eth_service import parse_data
+
+                raw = (req.get("params") or [None])[0]
+                stx = SignedTransaction.decode(parse_data(raw))
+                JOURNEY.record(stx.hash, "ingress", source="rpc",
+                               via="fleet")
+            except Exception:
+                pass  # a malformed tx fails in the service, not here
         replica: Optional[ReplicaDriver] = None
         is_read = routes_to_replica(method)
         if is_read and self.replicas:

@@ -38,6 +38,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from khipu_tpu.domain.account import Account
+from khipu_tpu.observability.journey import JOURNEY
 
 # distinguishes "address not covered by the overlay" from "address
 # deleted by an overlaid block" (which must read as absent)
@@ -59,9 +60,13 @@ class ReadView:
 
     # ----------------------------------------------------- pipeline side
 
-    def publish_block(self, header, accounts: Dict[bytes, Optional[Account]]) -> None:
+    def publish_block(self, header, accounts: Dict[bytes, Optional[Account]],
+                      txs: Optional[list] = None) -> None:
         """One executed block's account diff becomes visible ATOMICALLY
-        (driver thread, at window-session commit)."""
+        (driver thread, at window-session commit). ``txs`` — the
+        block's tx hashes, threaded from WindowCommitter.commit_block —
+        stamps the read-your-writes page of each tx's passport; None
+        (the default) when the journey plane is off."""
         number = header.number
         entries = {
             addr: (number, acc) for addr, acc in accounts.items()
@@ -72,6 +77,10 @@ class ReadView:
             if number > self._head:
                 self._head = number
             self.published += 1
+        if txs and JOURNEY.enabled:
+            for tx_hash in txs:
+                JOURNEY.record(tx_hash, "readview.publish",
+                               height=number)
 
     def retire_through(self, number: int) -> None:
         """Drop overlay entries the committed store now serves (the

@@ -45,6 +45,7 @@ from khipu_tpu.chaos import fault_point
 from khipu_tpu.config import KhipuConfig
 from khipu_tpu.domain.block import Block
 from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.observability.journey import JOURNEY, use_node
 from khipu_tpu.storage.storages import Storages
 
 
@@ -176,39 +177,58 @@ class ReplicaDriver:
                 break
             anc -= 1
         applied = 0
-        if anc < my:
-            # the primary switched below our tip; a mid-switch feed
-            # read can transiently show p_head == anc (best drops to
-            # the ancestor before rollback) — wait for the adopted
-            # branch to land rather than switch to an empty suffix
-            if p_head > anc:
-                hi = min(p_head, anc + self.batch)
-                blocks = []
-                for n in range(anc + 1, hi + 1):
+        # every stamp from this pass — re-execution lanes included —
+        # carries this replica's node label; the visibility page below
+        # feeds the replica_visible commit-latency histogram against
+        # the PRIMARY's ingress stamp (one shared process board)
+        with use_node(f"replica:{self.name}"):
+            if anc < my:
+                # the primary switched below our tip; a mid-switch feed
+                # read can transiently show p_head == anc (best drops to
+                # the ancestor before rollback) — wait for the adopted
+                # branch to land rather than switch to an empty suffix
+                if p_head > anc:
+                    hi = min(p_head, anc + self.batch)
+                    blocks = []
+                    for n in range(anc + 1, hi + 1):
+                        b = self.feed.block(n)
+                        if b is None:
+                            break
+                        blocks.append(b)
+                    if blocks:
+                        self.reorg.switch(anc, blocks)
+                        self.switches_mirrored += 1
+                        applied = len(blocks)
+                        if JOURNEY.enabled:
+                            self._stamp_visible(blocks)
+            elif p_head > my:
+                stats = ReplayStats()
+                hi = min(p_head, my + self.batch)
+                for n in range(my + 1, hi + 1):
+                    fault_point("replica.tail")
                     b = self.feed.block(n)
                     if b is None:
-                        break
-                    blocks.append(b)
-                if blocks:
-                    self.reorg.switch(anc, blocks)
-                    self.switches_mirrored += 1
-                    applied = len(blocks)
-        elif p_head > my:
-            stats = ReplayStats()
-            hi = min(p_head, my + self.batch)
-            for n in range(my + 1, hi + 1):
-                fault_point("replica.tail")
-                b = self.feed.block(n)
-                if b is None:
-                    break  # feed mid-mutation: retry next pass
-                self.driver._execute_and_insert(b, stats)
-                applied += 1
+                        break  # feed mid-mutation: retry next pass
+                    self.driver._execute_and_insert(b, stats)
+                    applied += 1
+                    if JOURNEY.enabled:
+                        self._stamp_visible([b])
         self.tail_passes += 1
         self.blocks_applied += applied
         if applied:
             with self._cv:
                 self._cv.notify_all()
         return applied
+
+    def _stamp_visible(self, blocks) -> None:
+        """The passport's per-replica visibility page: this replica's
+        tail height passed the tx's block — reads served here now see
+        it (the fleet token promise, measured per tx)."""
+        for b in blocks:
+            for stx in b.body.transactions:
+                JOURNEY.record(stx.hash, "replica.visible",
+                               replica=self.name,
+                               height=b.header.number)
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -58,6 +58,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Union
 
 from khipu_tpu.base.rlp import rlp_decode, rlp_encode
+from khipu_tpu.observability.journey import JOURNEY
 
 _INTENT_PREFIX = b"J"
 _COMMIT_PREFIX = b"C"
@@ -498,6 +499,14 @@ def _rollback_window(blockchain, rec: IntentRecord) -> int:
             try:
                 for tx in BlockBody.decode(body_raw).transactions:
                     s.transaction_storage.source.remove(tx.hash)
+                    if JOURNEY.enabled:
+                        # recovery truth on the passport: the tx's
+                        # half-committed window never reached the
+                        # commit mark — its journey ends before
+                        # durable and resumes when the re-import
+                        # stamps fresh pages
+                        JOURNEY.record(tx.hash, "journal.rollback",
+                                       block=n)
             except Exception:
                 pass  # a torn body still gets its by-number records cut
         if header_raw is not None:
@@ -671,6 +680,9 @@ def _remove_above(blockchain, ancestor: int, top: int) -> int:
             try:
                 for tx in BlockBody.decode(body_raw).transactions:
                     s.transaction_storage.source.remove(tx.hash)
+                    if JOURNEY.enabled:
+                        JOURNEY.record(tx.hash, "journal.rollback",
+                                       block=n)
             except Exception:
                 pass  # a torn body still gets its by-number records cut
         h = s.block_numbers.hash_of(n)

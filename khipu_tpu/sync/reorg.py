@@ -46,6 +46,7 @@ from khipu_tpu.chaos import fault_point
 from khipu_tpu.config import KhipuConfig
 from khipu_tpu.domain.block import Block
 from khipu_tpu.domain.blockchain import Blockchain
+from khipu_tpu.observability.journey import JOURNEY
 from khipu_tpu.observability.trace import span
 
 
@@ -162,6 +163,16 @@ class ReorgManager:
             # so a mid-switch death can still recycle them
             removed_hits = self._removed_hits(old_blocks)
             orphans = self._orphan_txs(old_blocks, blocks)
+            if JOURNEY.enabled:
+                # every tx on the losing branch gets its retraction
+                # page (PINNED — tail retention outlives the ring);
+                # re-inclusion is stamped at finalize once the branch
+                # actually won
+                for b in old_blocks:
+                    for stx in b.body.transactions:
+                        JOURNEY.record(stx.hash, "reorg.retract",
+                                       ancestor=ancestor_number,
+                                       block=b.header.number)
 
             journal = bc.storages.window_journal
             fault_point("reorg.intent")
@@ -328,6 +339,19 @@ class ReorgManager:
                   orphans: list, adopted: List[Block],
                   removed_hits: list) -> None:
         recycled = 0
+        if JOURNEY.enabled:
+            # re-inclusion pages: a retracted tx that was mined again
+            # on the winning branch closes the retract->reinclude arc
+            retracted = {
+                stx.hash for b in old_blocks
+                for stx in b.body.transactions
+            }
+            for b in adopted:
+                for stx in b.body.transactions:
+                    if stx.hash in retracted:
+                        JOURNEY.record(stx.hash, "reorg.reinclude",
+                                       via="mined",
+                                       block=b.header.number)
         if self.txpool is not None:
             for b in adopted:
                 # adopted-branch txs leave the pool, same as every
@@ -343,6 +367,11 @@ class ReorgManager:
                 try:
                     if self.txpool.add(stx):
                         recycled += 1
+                        if JOURNEY.enabled:
+                            # pool residence IS the re-inclusion state
+                            # for orphaned-only txs (awaiting re-mining)
+                            JOURNEY.record(stx.hash, "reorg.reinclude",
+                                           via="pool")
                 except ValueError:
                     pass
         for fn in list(self._listeners):

@@ -25,6 +25,7 @@ from khipu_tpu.domain.blockchain import Blockchain
 from khipu_tpu.domain.difficulty import calc_difficulty
 from khipu_tpu.ledger.ledger import execute_block
 from khipu_tpu.sync.prefetch import recover_block_senders
+from khipu_tpu.observability.journey import JOURNEY, current_node
 from khipu_tpu.observability.profiler import HOST, LEDGER
 from khipu_tpu.observability.registry import REGISTRY
 from khipu_tpu.observability.trace import (
@@ -871,6 +872,17 @@ class ReplayDriver:
                         if intent_seq is not None:
                             fault_point("collector.commit")
                             journal.log_commit(intent_seq)
+                        if JOURNEY.enabled:
+                            # persist+save done, commit mark down: the
+                            # crash-survivable point — the passport's
+                            # durable page (feeds the durable-latency
+                            # histogram with this ring's trace id)
+                            for b, _r in results:
+                                for stx in b.body.transactions:
+                                    JOURNEY.record(
+                                        stx.hash, "durable",
+                                        block=b.header.number,
+                                    )
                         if self.log is not None:
                             self.log(
                                 f"Committed window [{lo}..{hi}] "
@@ -910,6 +922,11 @@ class ReplayDriver:
             with span("window.seal", block_lo=lo, block_hi=hi) as seal_sp, \
                     LEDGER.context(window=lo, phase="seal"):
                 job = committer.seal()
+                if JOURNEY.enabled:
+                    for b, _r in results_cur:
+                        for stx in b.body.transactions:
+                            JOURNEY.record(stx.hash, "seal",
+                                           window_lo=lo, window_hi=hi)
                 if journal is not None:
                     # WAL barrier: the intent is durable BEFORE the job
                     # can run (submit enqueues it strictly afterwards).
@@ -927,6 +944,15 @@ class ReplayDriver:
                         "seal.journal", HOST, 0,
                         duration=time.perf_counter() - _j0,
                     )
+                    if JOURNEY.enabled:
+                        # the WAL intent is fsynced: from here a crash
+                        # replays the window forward — the passport's
+                        # journal-intent page
+                        for b, _r in results_cur:
+                            for stx in b.body.transactions:
+                                JOURNEY.record(stx.hash,
+                                               "journal.intent",
+                                               seq=intent_seq)
                 # stage-job closure build stays inside the span (it
                 # is part of sealing, and an unbilled sliver here
                 # loses GIL slices to the stage threads — see the
@@ -979,6 +1005,15 @@ class ReplayDriver:
                             sync.sender_batch_hash,
                         )
                     ph["senders"] += time.perf_counter() - t0
+                    if JOURNEY.enabled:
+                        # passport ingress for imported txs: FIRST
+                        # sighting wins, so an RPC-submitted tx keeps
+                        # its rpc ingress and a reorg re-import keeps
+                        # the original stamp
+                        for stx in block.body.transactions:
+                            JOURNEY.record(stx.hash, "ingress",
+                                           source="import",
+                                           block=header.number)
                     t0 = time.perf_counter()
                     if self.validate_headers:
                         self.header_validator.validate(header, prev)
@@ -1014,7 +1049,14 @@ class ReplayDriver:
                         )
                     ph["execute"] += time.perf_counter() - t0
                     t0 = time.perf_counter()
-                    committer.commit_block(result.world, header)
+                    committer.commit_block(
+                        result.world, header,
+                        txs=(
+                            [stx.hash
+                             for stx in block.body.transactions]
+                            if JOURNEY.enabled else None
+                        ),
+                    )
                     ph["commit"] += time.perf_counter() - t0
                     # window bookkeeping stays INSIDE the span: each
                     # statement outside a driver phase is a chance to
@@ -1107,6 +1149,16 @@ class ReplayDriver:
         parent = self.blockchain.get_header_by_number(header.number - 1)
         if parent is None:
             raise ValueError(f"no parent for block {header.number}")
+        # passport stamps for the per-block import path (live sync,
+        # reorg adopt). A replica's tail re-execution runs under
+        # use_node("replica:...") and stamps ONLY its own visibility
+        # page (serving/replica.py) — ingress/durable belong to the
+        # primary plane
+        journeys = JOURNEY.enabled and current_node() == "primary"
+        if journeys:
+            for stx in block.body.transactions:
+                JOURNEY.record(stx.hash, "ingress", source="import",
+                               block=header.number)
         if self.validate_headers:
             self.header_validator.validate(header, parent)
         BlockValidator.validate_body(block)
@@ -1132,6 +1184,10 @@ class ReplayDriver:
         self.blockchain.save_block(
             block, result.receipts, td, result.world, hasher=self.hasher
         )
+        if journeys:
+            for stx in block.body.transactions:
+                JOURNEY.record(stx.hash, "durable",
+                               block=header.number)
         dt = time.perf_counter() - t0
 
         stats.blocks += 1

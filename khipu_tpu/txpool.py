@@ -15,6 +15,7 @@ from typing import List, Optional
 
 from khipu_tpu.domain.block_header import BlockHeader
 from khipu_tpu.domain.transaction import SignedTransaction
+from khipu_tpu.observability.journey import JOURNEY
 
 
 class PendingTransactionsPool:
@@ -77,22 +78,35 @@ class PendingTransactionsPool:
                 return False
             slot = (stx.sender, stx.tx.nonce)
             pooled_hash = self._by_sender_nonce.get(slot)
+            replaced = False
             if pooled_hash is not None:
                 pooled = self._txs[pooled_hash]
                 if stx.tx.gas_price <= pooled.tx.gas_price:
                     self.rejected_underpriced += 1
+                    if JOURNEY.enabled:
+                        JOURNEY.record(stx.hash, "pool.reject",
+                                       reason="underpriced")
                     return False
                 del self._txs[pooled_hash]  # outbid: replace in place
                 del self._by_sender_nonce[slot]
                 self.replacements += 1
+                replaced = True
+                if JOURNEY.enabled:
+                    JOURNEY.record(pooled_hash, "pool.evict",
+                                   reason="replaced")
             while len(self._txs) >= self.capacity:
                 oldest_hash, oldest = self._txs.popitem(last=False)
                 oslot = (oldest.sender, oldest.tx.nonce)
                 if self._by_sender_nonce.get(oslot) == oldest_hash:
                     del self._by_sender_nonce[oslot]
                 self.evictions += 1
+                if JOURNEY.enabled:
+                    JOURNEY.record(oldest_hash, "pool.evict",
+                                   reason="capacity")
             self._txs[stx.hash] = stx
             self._by_sender_nonce[slot] = stx.hash
+            if JOURNEY.enabled:
+                JOURNEY.record(stx.hash, "pool.admit", replaced=replaced)
             self._arrivals.append(stx.hash)
             # bound the journal: keep the most recent 4x capacity
             if len(self._arrivals) > 4 * self.capacity:

@@ -56,6 +56,12 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --reorg --smoke
 echo "== ingest smoke (segment ingest < 3x the per-node walk, read amp >= 1.5x, or a missing khipu_kesque_* family fails the gate) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --ingest --smoke
 
+echo "== conformance corpus (any failing GeneralStateTest case — statetest_pass_rate < 1.0 — fails the gate) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --conformance
+
+echo "== tx passport smoke (missing ingress->durable / ingress->replica-visible p99, <99% complete journeys, no retraction-crossing or vector-lane journey, or a khipu_tx_* family rendered more than once-per-TYPE fails the gate) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --serve --smoke
+
 echo "== fleet serve smoke (a stale read under a consistent-read token, an unmirrored reorg, or a missing khipu_fleet_* family fails the gate) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --serve --http --smoke
 

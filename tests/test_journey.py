@@ -1,0 +1,407 @@
+"""Tx passport truth under failure (observability/journey.py —
+docs/observability.md "Transaction passport").
+
+The headline guarantees: a reorg-retracted tx's journey shows the
+retraction page and then its re-inclusion (``via=mined`` on the
+adopted branch, or ``via=pool`` residence for orphan-only txs); a
+journey for a tx whose window died mid background save truthfully
+ends BEFORE the persist-durable page and resumes after ``recover()``;
+and a replay with the board disabled allocates NOTHING on the board
+while landing on a bit-exact chain vs the instrumented run.
+"""
+
+import dataclasses
+
+import pytest
+
+from khipu_tpu.base.crypto.secp256k1 import (
+    privkey_to_pubkey,
+    pubkey_to_address,
+)
+from khipu_tpu.chaos import FaultPlan, FaultRule, active
+from khipu_tpu.config import SyncConfig, fixture_config
+from khipu_tpu.domain.blockchain import Blockchain, GenesisSpec
+from khipu_tpu.domain.transaction import Transaction, sign_transaction
+from khipu_tpu.observability.journey import (
+    JOURNEY,
+    JourneyBoard,
+    journey_sampled,
+    use_node,
+)
+from khipu_tpu.observability.registry import MetricsRegistry
+from khipu_tpu.storage.storages import Storages
+from khipu_tpu.sync.chain_builder import ChainBuilder
+from khipu_tpu.sync.journal import recover
+from khipu_tpu.sync.reorg import ReorgManager
+from khipu_tpu.sync.replay import CollectorDied, ReplayDriver, ReplayStats
+from khipu_tpu.txpool import PendingTransactionsPool
+
+pytestmark = pytest.mark.chaos
+
+CFG = dataclasses.replace(
+    fixture_config(chain_id=1),
+    sync=SyncConfig(commit_window_blocks=1, parallel_tx=False),
+)
+KEYS = [(i + 1).to_bytes(32, "big") for i in range(4)]
+ADDRS = [pubkey_to_address(privkey_to_pubkey(k)) for k in KEYS]
+ETH = 10**18
+ALLOC = {a: 1000 * ETH for a in ADDRS}
+GEN = GenesisSpec(alloc=ALLOC)
+MINER_A = b"\xaa" * 20
+MINER_B = b"\xbb" * 20
+
+
+@pytest.fixture(autouse=True)
+def _clean_board():
+    """Every test starts and leaves with a disabled, empty board —
+    journey state must never leak across tests (or into other files
+    sharing the process)."""
+    JOURNEY.disable()
+    JOURNEY.reset()
+    yield
+    JOURNEY.disable()
+    JOURNEY.reset()
+
+
+def _tx(i, nonce, to, value, gas_price=10**9):
+    return sign_transaction(
+        Transaction(nonce, gas_price, 21_000, to, value),
+        KEYS[i], chain_id=1,
+    )
+
+
+def build(n, diverge_at=None, value_off=0):
+    """Consensus-true chain of ``n`` transfer blocks; from
+    ``diverge_at`` on the coinbase flips to MINER_B and values shift
+    by ``value_off`` (0 keeps the SAME txs on a different branch — the
+    re-mined re-inclusion case)."""
+    builder = ChainBuilder(Blockchain(Storages(), CFG), CFG, GEN)
+    blocks, nonces = [], [0, 0, 0, 0]
+    for k in range(n):
+        i = k % 4
+        diverged = diverge_at is not None and k >= diverge_at
+        blocks.append(builder.add_block(
+            [_tx(i, nonces[i], ADDRS[(i + 1) % 4],
+                 100 + k + (value_off if diverged else 0))],
+            coinbase=MINER_B if diverged else MINER_A,
+            timestamp=10 * (k + 1),
+        ))
+        nonces[i] += 1
+    return blocks
+
+
+@pytest.fixture(scope="module")
+def chains():
+    return {
+        "base": build(8),
+        # different txs past the fork point: orphan-only → via=pool
+        "fork": build(10, diverge_at=5, value_off=1000),
+        # SAME txs past the fork point: re-mined → via=mined
+        "mined": build(10, diverge_at=5, value_off=0),
+        "long": build(12),
+    }
+
+
+def fresh_node(blocks, upto, config=CFG):
+    bc = Blockchain(Storages(), config)
+    bc.load_genesis(GEN)
+    driver = ReplayDriver(bc, config)
+    stats = ReplayStats()
+    for b in blocks[:upto]:
+        driver._execute_and_insert(b, stats)
+    return bc, driver
+
+
+def _edges(j):
+    return [e[1] for e in j.events]
+
+
+def _assert_monotonic(j):
+    ts = [e[0] for e in j.events]
+    assert ts == sorted(ts), "journey events out of time order"
+
+
+# ------------------------------------------------------ reorg journeys
+
+
+class TestReorgJourney:
+    def test_retracted_tx_shows_retract_then_pool_residence(self, chains):
+        """Orphan-only txs: the journey closes the retract arc with
+        ``reorg.reinclude via=pool`` — pool residence IS the
+        re-inclusion state while the tx awaits re-mining."""
+        JOURNEY.enable()
+        bc, driver = fresh_node(chains["base"], 8)
+        pool = PendingTransactionsPool()
+        mgr = ReorgManager(bc, CFG, driver=driver, txpool=pool)
+        mgr.switch(5, chains["fork"][5:])
+        assert bc.best_block_number == 10
+
+        orphans = [
+            stx for b in chains["base"][5:]
+            for stx in b.body.transactions
+        ]
+        assert len(orphans) == 3
+        for stx in orphans:
+            j = JOURNEY.get(stx.hash)
+            assert j is not None, "retracted tx lost from the board"
+            edges = _edges(j)
+            # the full arc, in order: imported and durable on the
+            # losing branch, retracted by the switch, back in the pool
+            assert edges.index("ingress") < edges.index("durable")
+            assert (edges.index("durable")
+                    < edges.index("reorg.retract")
+                    < edges.index("reorg.reinclude"))
+            _assert_monotonic(j)
+            # retraction pins the journey into tail retention
+            assert j.pin_reason is not None
+            via = [d for (_, e, _, _, d) in j.events
+                   if e == "reorg.reinclude"][0]
+            assert via["via"] == "pool"
+            assert pool.get(stx.hash) is not None
+
+    def test_retracted_tx_remined_on_adopted_branch(self, chains):
+        """Same txs on the winning branch: the arc closes with
+        ``reorg.reinclude via=mined`` and a second durable page from
+        the adopted block's import."""
+        JOURNEY.enable()
+        bc, driver = fresh_node(chains["base"], 8)
+        mgr = ReorgManager(bc, CFG, driver=driver)
+        mgr.switch(5, chains["mined"][5:])
+        assert bc.best_block_number == 10
+
+        for b in chains["base"][5:]:
+            for stx in b.body.transactions:
+                j = JOURNEY.get(stx.hash)
+                assert j is not None
+                edges = _edges(j)
+                ri = edges.index("reorg.reinclude")
+                assert edges.index("reorg.retract") < ri
+                via = j.events[ri][4]
+                assert via["via"] == "mined"
+                # re-imported on the adopted branch → a second durable
+                # page lands after the retraction (the re-import runs
+                # during adoption, before finalize stamps re-inclusion)
+                last_durable = (len(edges) - 1
+                                - edges[::-1].index("durable"))
+                assert edges.index("reorg.retract") < last_durable
+                assert edges.count("durable") == 2
+                _assert_monotonic(j)
+
+    def test_export_shape_for_retracted_journey(self, chains):
+        JOURNEY.enable()
+        bc, driver = fresh_node(chains["base"], 8)
+        mgr = ReorgManager(bc, CFG, driver=driver,
+                           txpool=PendingTransactionsPool())
+        mgr.switch(5, chains["fork"][5:])
+        stx = chains["base"][5].body.transactions[0]
+        rec = JOURNEY.export(stx.hash)
+        assert rec is not None
+        assert rec["txHash"] == "0x" + stx.hash.hex()
+        assert rec["pinned"] is not None
+        edges = [e["edge"] for e in rec["events"]]
+        assert "reorg.retract" in edges and "reorg.reinclude" in edges
+        ts = [e["t"] for e in rec["events"]]
+        assert ts == sorted(ts)
+        for e in rec["events"]:
+            assert e["wall"] == pytest.approx(JOURNEY.to_wall(e["t"]))
+
+
+# -------------------------------------------------- kill mid window
+
+
+class TestKillMidWindowJourney:
+    def _cfg(self, window=2, depth=2):
+        return dataclasses.replace(
+            CFG,
+            sync=SyncConfig(
+                parallel_tx=False,
+                commit_window_blocks=window,
+                pipeline_depth=depth,
+                degrade_on_collector_death=False,
+                collector_join_timeout=5.0,
+                adaptive_commit=False,
+            ),
+        )
+
+    def test_journey_truthfully_ends_before_durable(self, chains):
+        """The collector dies right after block 5's save — block 6 and
+        the window's commit mark never land. The passports for BOTH
+        window txs must end before the durable page (a saved-but-
+        unmarked block is NOT durable), gain a rollback page from
+        recovery, and pick the durable page back up on resume."""
+        chain = chains["long"]
+        cfg = self._cfg()
+        JOURNEY.enable()
+        bc = Blockchain(Storages(), cfg)
+        bc.load_genesis(GEN)
+        plan = FaultPlan(
+            seed=3, rules=[FaultRule("collector.save", "die", after=4,
+                                     times=1)]
+        )
+        with active(plan):
+            with pytest.raises(CollectorDied):
+                ReplayDriver(bc, cfg).replay(chain)
+        assert [s for (s, _, _, _) in plan.fired] == ["collector.save"]
+        assert bc.storages.app_state.best_block_number == 5
+
+        tx5 = chain[4].body.transactions[0]
+        tx6 = chain[5].body.transactions[0]
+        for stx in (tx5, tx6):
+            j = JOURNEY.get(stx.hash)
+            assert j is not None
+            edges = _edges(j)
+            # the window got as far as its WAL intent...
+            assert "ingress" in edges and "seal" in edges
+            assert "journal.intent" in edges
+            # ...and the passport does NOT claim durability the crash
+            # would disprove
+            assert "durable" not in edges
+
+        report = recover(bc, config=cfg)
+        assert report.rolled_back >= 1
+        assert bc.best_block_number == 4
+        j5 = JOURNEY.get(tx5.hash)
+        edges5 = _edges(j5)
+        assert "journal.rollback" in edges5
+        assert "durable" not in edges5
+        assert j5.pin_reason == "rolled-back"
+
+        # resume where recovery left off: the journey picks the
+        # durable page up AFTER the rollback page, still in time order
+        resume_cfg = self._cfg(window=1, depth=1)
+        ReplayDriver(bc, resume_cfg).replay(chain[4:])
+        assert bc.best_block_number == 12
+        for stx in (tx5, tx6):
+            j = JOURNEY.get(stx.hash)
+            edges = _edges(j)
+            assert "durable" in edges
+            _assert_monotonic(j)
+        edges5 = _edges(JOURNEY.get(tx5.hash))
+        assert (edges5.index("journal.rollback")
+                < len(edges5) - 1 - edges5[::-1].index("durable"))
+
+
+# ------------------------------------------------- disabled = zero cost
+
+
+class TestDisabledZeroCost:
+    def test_disabled_replay_bit_exact_with_zero_allocations(self, chains):
+        """Replay with the board off allocates NOTHING on it (no
+        journeys, no event counters) and the chain it lands on is
+        bit-exact vs the instrumented run — stamps never steer
+        execution."""
+        chain = chains["long"]
+        cfg = dataclasses.replace(
+            CFG,
+            sync=SyncConfig(parallel_tx=False, commit_window_blocks=2,
+                            pipeline_depth=2, adaptive_commit=False),
+        )
+        assert not JOURNEY.enabled
+        bc_off = Blockchain(Storages(), cfg)
+        bc_off.load_genesis(GEN)
+        ReplayDriver(bc_off, cfg).replay(chain)
+        assert len(JOURNEY) == 0
+        assert JOURNEY.events_total == 0
+        assert JOURNEY.evicted_total == 0
+
+        JOURNEY.enable()
+        bc_on = Blockchain(Storages(), cfg)
+        bc_on.load_genesis(GEN)
+        ReplayDriver(bc_on, cfg).replay(chain)
+        assert len(JOURNEY) > 0
+        assert JOURNEY.events_total > 0
+
+        assert (bc_off.best_block_number == bc_on.best_block_number
+                == 12)
+        for n in range(13):
+            a = bc_off.get_header_by_number(n)
+            b = bc_on.get_header_by_number(n)
+            assert a.hash == b.hash, f"block {n} diverged"
+            assert a.state_root == b.state_root
+
+
+# ----------------------------------------------------- board mechanics
+
+
+class TestBoardMechanics:
+    def _board(self, **kw):
+        b = JourneyBoard(**kw)
+        b.enable()
+        return b
+
+    def test_first_ingress_wins(self):
+        b = self._board()
+        h = b"\x01" * 32
+        b.record(h, "ingress", source="rpc")
+        b.record(h, "ingress", source="import")
+        j = b.get(h)
+        assert len([e for e in j.events if e[1] == "ingress"]) == 1
+        assert j.events[0][4]["source"] == "rpc"
+
+    def test_pinned_journeys_survive_ring_eviction(self):
+        b = self._board(capacity=4, pinned_capacity=4)
+        shed = b"\xfe" * 32
+        b.record(shed, "ingress", source="rpc")
+        b.record(shed, "pool.evict", reason="capacity")
+        for i in range(16):
+            b.record(i.to_bytes(32, "big"), "ingress", source="rpc")
+        assert b.evicted_total > 0
+        j = b.get(shed)
+        assert j is not None and j.pin_reason == "shed"
+
+    def test_sampling_is_deterministic_in_the_hash(self):
+        h = b"\x2a" * 32
+        assert journey_sampled(h, 10_000)
+        assert not journey_sampled(h, 0)
+        first = journey_sampled(h, 500)
+        assert all(journey_sampled(h, 500) == first for _ in range(8))
+        # an unsampled happy-path tx STILL lands when a pin edge fires
+        b = self._board(sample_per_10k=0)
+        b.record(h, "ingress", source="rpc")
+        assert b.get(h) is None
+        b.record(h, "pool.evict", reason="capacity")
+        assert b.get(h) is not None
+
+    def test_max_events_truncates_but_keeps_terminal_edges(self):
+        b = self._board(max_events=4)
+        h = b"\x03" * 32
+        b.record(h, "ingress", source="rpc")
+        for i in range(8):
+            b.record(h, "execute", lane="checked", index=i)
+        b.record(h, "durable", block=9)
+        j = b.get(h)
+        assert len(j.events) == 5  # ingress + 3 executes + durable
+        assert j.truncated == 5
+        assert _edges(j)[-1] == "durable"
+        rec = b.export(h)
+        assert rec["truncatedEvents"] == 5
+
+    def test_slow_tail_pins_on_durable(self):
+        b = self._board(slow_ms=0.0)
+        h = b"\x04" * 32
+        b.record(h, "ingress", source="rpc")
+        b.record(h, "durable", block=1)
+        assert b.get(h).pin_reason == "slow"
+        assert b.latencies_ms("durable")[0] >= 0.0
+
+    def test_node_label_rides_the_stamp(self):
+        b = self._board()
+        h = b"\x05" * 32
+        b.record(h, "ingress", source="rpc")
+        with use_node("replica:r1"):
+            b.record(h, "replica.visible", height=3)
+        nodes = [e[2] for e in b.get(h).events]
+        assert nodes == ["primary", "replica:r1"]
+
+    def test_exemplar_trace_id_rides_the_exposition(self):
+        """The histogram bucket line carries the owning trace id as an
+        OpenMetrics-style exemplar — the link from a latency bucket to
+        the flight-recorder ring that owns the journey's spans."""
+        reg = MetricsRegistry()
+        hist = reg.histogram(
+            "t_commit_seconds", labels={"edge": "durable"}
+        )
+        hist.observe(0.012, exemplar="deadbeefcafe")
+        text = reg.prometheus_text()
+        assert text.count("# TYPE t_commit_seconds histogram") == 1
+        assert 'trace_id="deadbeefcafe"' in text
